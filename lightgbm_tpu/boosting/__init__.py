@@ -1,0 +1,13 @@
+from .gbdt import GBDT
+from .dart import DART
+from .goss import GOSS
+from .rf import RF
+
+
+def create_boosting(cfg, train_data=None, objective=None):
+    """Factory (`src/boosting/boosting.cpp:30-63`)."""
+    table = {"gbdt": GBDT, "gbrt": GBDT, "dart": DART, "goss": GOSS, "rf": RF,
+             "random_forest": RF}
+    if cfg.boosting not in table:
+        raise ValueError(f"Unknown boosting type {cfg.boosting}")
+    return table[cfg.boosting](cfg, train_data, objective)

@@ -1,0 +1,123 @@
+"""DART — dropout boosting (`src/boosting/dart.hpp:29-210`).
+
+Per iteration: randomly drop trained trees (weighted or uniform), subtract
+their contribution from the training score, fit the new tree against the
+reduced ensemble, then renormalize the dropped trees and the new tree so
+expected predictions stay consistent (`dart.hpp:152-196` Normalize).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ..binning import kEpsilon
+from .gbdt import GBDT, _traverse_tree_binned
+
+
+class DART(GBDT):
+    name = "dart"
+
+    def __init__(self, cfg, train_data=None, objective=None):
+        self.tree_weight: List[float] = []
+        self.sum_weight = 0.0
+        self.drop_index: List[int] = []
+        super().__init__(cfg, train_data, objective)
+        self._drop_rng = np.random.RandomState(cfg.drop_seed)
+
+    def _add_tree_score_train(self, tree, class_id):
+        if tree.num_leaves > 1:
+            delta = _traverse_tree_binned(self.train_data, tree)
+            self.train_score.score = self.train_score.score.at[class_id].add(delta)
+        else:
+            self.train_score.add_constant(float(tree.leaf_value[0]), class_id)
+
+    def _dropping_trees(self) -> None:
+        """`dart.hpp:90-143`."""
+        cfg = self.cfg
+        self.drop_index = []
+        if self._drop_rng.rand() >= cfg.skip_drop:
+            drop_rate = cfg.drop_rate
+            if not cfg.uniform_drop:
+                if self.sum_weight > 0:
+                    inv_avg = len(self.tree_weight) / self.sum_weight
+                    if cfg.max_drop > 0:
+                        drop_rate = min(drop_rate,
+                                        cfg.max_drop * inv_avg / self.sum_weight)
+                    for i in range(self.iter_):
+                        if self._drop_rng.rand() < drop_rate * self.tree_weight[i] * inv_avg:
+                            self.drop_index.append(i)
+                            if len(self.drop_index) >= cfg.max_drop > 0:
+                                break
+            else:
+                if cfg.max_drop > 0 and self.iter_ > 0:
+                    drop_rate = min(drop_rate, cfg.max_drop / self.iter_)
+                for i in range(self.iter_):
+                    if self._drop_rng.rand() < drop_rate:
+                        self.drop_index.append(i)
+                        if len(self.drop_index) >= cfg.max_drop > 0:
+                            break
+        # subtract dropped trees from the training score
+        for i in self.drop_index:
+            for k in range(self.num_tree_per_iteration):
+                tree = self.models[i * self.num_tree_per_iteration + k]
+                tree.apply_shrinkage(-1.0)
+                self._add_tree_score_train(tree, k)
+        n_drop = len(self.drop_index)
+        if not cfg.xgboost_dart_mode:
+            self.shrinkage_rate = cfg.learning_rate / (1.0 + n_drop)
+        else:
+            self.shrinkage_rate = cfg.learning_rate if n_drop == 0 else \
+                cfg.learning_rate / (cfg.learning_rate + n_drop)
+
+    def train_one_iter(self, gradients=None, hessians=None) -> bool:
+        self._dropping_trees()
+        ret = super().train_one_iter(gradients, hessians)
+        if ret:
+            # failed iteration: undo the drop exactly (un-negate the dropped
+            # trees and restore their training-score contribution)
+            for i in self.drop_index:
+                for k in range(self.num_tree_per_iteration):
+                    tree = self.models[i * self.num_tree_per_iteration + k]
+                    tree.apply_shrinkage(-1.0)
+                    self._add_tree_score_train(tree, k)
+            self.shrinkage_rate = self.cfg.learning_rate
+            return ret
+        self._normalize()
+        if not self.cfg.uniform_drop:
+            self.tree_weight.append(self.shrinkage_rate)
+            self.sum_weight += self.shrinkage_rate
+        return False
+
+    def eval_and_check_early_stopping(self, log=None) -> bool:
+        # DART never early-stops (`dart.hpp:83-86`)
+        self.output_metric(self.iter_, log)
+        return False
+
+    def _normalize(self) -> None:
+        """`dart.hpp:152-196`."""
+        cfg = self.cfg
+        k = float(len(self.drop_index))
+        for i in self.drop_index:
+            for cid in range(self.num_tree_per_iteration):
+                tree = self.models[i * self.num_tree_per_iteration + cid]
+                if not cfg.xgboost_dart_mode:
+                    tree.apply_shrinkage(1.0 / (k + 1.0))
+                    for vs in self.valid_scores:
+                        vs.add_by_tree(tree, cid)
+                    tree.apply_shrinkage(-k)
+                    self._add_tree_score_train(tree, cid)
+                else:
+                    tree.apply_shrinkage(self.shrinkage_rate)
+                    for vs in self.valid_scores:
+                        vs.add_by_tree(tree, cid)
+                    tree.apply_shrinkage(-k / cfg.learning_rate)
+                    self._add_tree_score_train(tree, cid)
+            if not cfg.uniform_drop:
+                if not cfg.xgboost_dart_mode:
+                    self.sum_weight -= self.tree_weight[i] * (1.0 / (k + 1.0))
+                    self.tree_weight[i] *= k / (k + 1.0)
+                else:
+                    self.sum_weight -= self.tree_weight[i] * (1.0 / (k + cfg.learning_rate))
+                    self.tree_weight[i] *= k / (k + cfg.learning_rate)

@@ -1,0 +1,629 @@
+"""GBDT — the main boosting loop.
+
+TPU-native re-design of ``GBDT`` (`src/boosting/gbdt.{h,cpp}`): the Python
+host drives iterations while every O(N) step — gradient computation, bagged
+histogram trees, score updates, validation-score tree traversal — runs as
+jitted device work over the padded row axis.
+
+Loop structure mirrors ``GBDT::TrainOneIter`` (`gbdt.cpp:333-413`):
+boost-from-average (`gbdt.cpp:309-331`), gradients (`gbdt.cpp:149-157`),
+bagging (`gbdt.cpp:180-241`), per-class tree training, objective leaf
+renewal, shrinkage, score update (`gbdt.cpp:451-474`), metric output with
+early-stopping bookkeeping (`gbdt.cpp:476-533`), and the ``AddBias`` /
+``AsConstantTree`` init-score folding.  Model text serialization follows
+`src/boosting/gbdt_model_text.cpp:244-341`.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..binning import kEpsilon
+from ..config import Config
+from ..dataset import Dataset, _ConstructedDataset
+from ..learner import TPUTreeLearner
+from ..metrics import Metric, create_metric
+from ..objectives import ObjectiveFunction, create_objective
+from ..tree import Tree
+
+K_MODEL_VERSION = "v2"
+
+
+class ScoreUpdater:
+    """Running raw scores for one dataset (`src/boosting/score_updater.hpp`).
+    Scores live on device as (K, N_pad) f32."""
+
+    def __init__(self, data: _ConstructedDataset, num_class: int):
+        self.data = data
+        self.num_class = num_class
+        self.num_data = data.num_data
+        n_pad = data.num_data_padded
+        score = np.zeros((num_class, n_pad), dtype=np.float32)
+        self.has_init_score = False
+        init = data.metadata.init_score
+        if init is not None:
+            self.has_init_score = True
+            init = np.asarray(init, dtype=np.float32)
+            if len(init) == self.num_data * num_class:
+                score[:, :self.num_data] = init.reshape(num_class, self.num_data)
+            else:
+                score[:, :self.num_data] = init[None, :self.num_data]
+        self.score = jnp.asarray(score)
+        self._bins_cache = None
+
+    def add_constant(self, val: float, class_id: int) -> None:
+        self.score = self.score.at[class_id].add(np.float32(val))
+
+    def add_by_leaf_id(self, leaf_values: np.ndarray, leaf_id: jax.Array,
+                       class_id: int) -> None:
+        """Train-side update: gather the (host-renewed, shrunk) leaf values by
+        the learner's final leaf partition (`score_updater.hpp:74-96`)."""
+        lv = jnp.asarray(leaf_values.astype(np.float32))
+        self.score = self.score.at[class_id].add(lv[leaf_id])
+
+    def add_by_tree(self, tree: Tree, class_id: int) -> None:
+        """Valid-side update: traverse the tree over this dataset's binned
+        matrix on device (`score_updater.hpp:97-105` AddScore(tree))."""
+        if tree.num_leaves <= 1:
+            self.add_constant(float(tree.leaf_value[0]), class_id)
+            return
+        delta = _traverse_tree_binned(self.data, tree)
+        self.score = self.score.at[class_id].add(delta)
+
+    def np_score(self) -> np.ndarray:
+        """(n, K) raw scores on host (unpadded)."""
+        s = np.asarray(self.score)[:, :self.num_data]
+        return s.T if self.num_class > 1 else s[0]
+
+
+def rebind_tree_to_dataset(tree: Tree, data: _ConstructedDataset) -> None:
+    """Reconstruct the inner (bin-space) split fields of a deserialized tree
+    — ``split_feature_inner`` / ``threshold_in_bin`` are not part of the model
+    text format (`src/io/tree.cpp:207-240`); the reference rebuilds them on
+    load the same way (real feature index → used-feature slot, real threshold
+    → bin via the mapper's upper bounds)."""
+    if not getattr(tree, "needs_rebind", False):
+        return
+    real2inner = {int(j): k for k, j in enumerate(data.used_feature_map)}
+    for nd in range(tree.num_leaves - 1):
+        real = int(tree.split_feature[nd])
+        inner = real2inner.get(real)
+        if inner is None:
+            raise ValueError(
+                f"Model splits on feature {real} which is trivial/unused in "
+                "the training data; cannot continue training on this dataset")
+        tree.split_feature_inner[nd] = inner
+        if not (tree.decision_type[nd] & 1):  # numerical
+            tree.threshold_in_bin[nd] = data.bin_mappers[inner].value_to_bin(
+                float(tree.threshold[nd]))
+    tree.needs_rebind = False
+
+
+def _traverse_tree_binned(data: _ConstructedDataset, tree: Tree) -> jax.Array:
+    """Vectorized inner-bin traversal (``NumericalDecisionInner``,
+    `tree.h:233-249`) over all rows of a binned dataset.
+
+    The per-node device arrays depend only on the tree and the (shared) bin
+    mappers, so they are built once per tree and cached on it — train and
+    valid sets reuse the same pack.
+    """
+    ni = tree.num_leaves - 1
+    pack = getattr(tree, "_traverse_pack", None)
+    if pack is None or pack[0] != tree.num_leaves:
+        num_bin, missing, default_bin, _ = data.feature_meta_arrays()
+        feat = tree.split_feature_inner[:ni]
+        depth = int(tree.leaf_depth[:tree.num_leaves].max())
+        pack = (tree.num_leaves, depth,
+                jnp.asarray(feat), jnp.asarray(tree.threshold_in_bin[:ni]),
+                jnp.asarray(missing[feat]), jnp.asarray(default_bin[feat]),
+                jnp.asarray(num_bin[feat] - 1),
+                jnp.asarray((tree.decision_type[:ni] & 2) != 0),
+                jnp.asarray(tree.left_child[:ni]),
+                jnp.asarray(tree.right_child[:ni]))
+        tree._traverse_pack = pack
+    _, depth, feat, thr, node_missing, node_default_bin, node_nan_bin, \
+        node_default_left, left_child, right_child = pack
+    # leaf values change under DART re-shrinkage, so always ship them fresh
+    leaf_value = jnp.asarray(tree.leaf_value[:tree.num_leaves]
+                             .astype(np.float32))
+    return _traverse_jit(
+        data.device_bins(), feat, thr, node_missing, node_default_bin,
+        node_nan_bin, node_default_left, left_child, right_child,
+        leaf_value, depth)
+
+
+import functools
+
+
+@functools.partial(jax.jit, static_argnames=("depth",))
+def _traverse_jit(bins, feat, thr, node_missing, node_default_bin,
+                  node_nan_bin, node_default_left, left_child, right_child,
+                  leaf_value, depth):
+    n = bins.shape[1]
+    node = jnp.zeros(n, dtype=jnp.int32)
+    rows = jnp.arange(n)
+
+    def step(node, _):
+        nd = jnp.maximum(node, 0)  # leaves encoded negative; keep stable
+        f = feat[nd]
+        fv = bins[f, rows].astype(jnp.int32)
+        mt = node_missing[nd]
+        is_missing = ((mt == 1) & (fv == node_default_bin[nd])) | \
+                     ((mt == 2) & (fv == node_nan_bin[nd]))
+        go_left = jnp.where(is_missing, node_default_left[nd], fv <= thr[nd])
+        nxt = jnp.where(go_left, left_child[nd], right_child[nd])
+        return jnp.where(node < 0, node, nxt), None
+
+    node, _ = jax.lax.scan(step, node, None, length=depth)
+    leaf = jnp.where(node < 0, ~node, 0)
+    return leaf_value[leaf]
+
+
+class GBDT:
+    """Reference `src/boosting/gbdt.h:24`."""
+
+    name = "gbdt"
+
+    def __init__(self, cfg: Config, train_data: Optional[Dataset] = None,
+                 objective: Optional[ObjectiveFunction] = None):
+        self.cfg = cfg
+        self.iter_ = 0
+        self.models: List[Tree] = []
+        self.train_data: Optional[_ConstructedDataset] = None
+        self.objective = objective
+        self.num_tree_per_iteration = 1
+        self.shrinkage_rate = cfg.learning_rate
+        self.max_feature_idx = 0
+        self.label_idx = 0
+        self.feature_names: List[str] = []
+        self.feature_infos: List[str] = []
+        self.learner: Optional[TPUTreeLearner] = None
+        self.train_score: Optional[ScoreUpdater] = None
+        self.valid_scores: List[ScoreUpdater] = []
+        self.valid_names: List[str] = []
+        self.training_metrics: List[Metric] = []
+        self.valid_metrics: List[List[Metric]] = []
+        self.best_score: List[List[float]] = []
+        self.best_iter: List[List[int]] = []
+        self.best_msg: List[List[str]] = []
+        self.class_need_train: List[bool] = []
+        self._bag_rng = np.random.RandomState(cfg.bagging_seed)
+        self._feat_rng = np.random.RandomState(cfg.feature_fraction_seed)
+        self.loaded_parameter = ""
+        self.average_output = False
+        self.eval_history: Dict[str, Dict[str, List[float]]] = {}
+        if train_data is not None:
+            self.init(train_data, objective)
+
+    # -- GBDT::Init (`gbdt.cpp:45-137`) -------------------------------------
+
+    def init(self, train_data: Dataset, objective: Optional[ObjectiveFunction],
+             training_metrics: Sequence[Metric] = ()) -> None:
+        data = train_data.constructed
+        self.train_data = data
+        self.objective = objective
+        self.num_tree_per_iteration = (
+            objective.num_model_per_iteration if objective is not None
+            else max(self.cfg.num_class, 1))
+        if objective is not None:
+            objective.init(data.metadata, data.num_data, data.num_data_padded)
+        self.learner = TPUTreeLearner(self.cfg, data)
+        self.train_score = ScoreUpdater(data, self.num_tree_per_iteration)
+        self.training_metrics = list(training_metrics)
+        self.max_feature_idx = data.num_total_features - 1
+        self.feature_names = list(data.feature_names)
+        self.feature_infos = _feature_infos(data)
+        self.class_need_train = [
+            objective.class_need_train(k) if objective is not None else True
+            for k in range(self.num_tree_per_iteration)]
+        n_pad = data.num_data_padded
+        base = np.zeros(n_pad, dtype=np.float32)
+        base[:data.num_data] = 1.0
+        self._valid_rows = jnp.asarray(base)     # 0 on padded rows
+        self.num_data = data.num_data
+        self._bag_mask = self._valid_rows
+        self._bag_cnt = data.num_data
+        self._np_bag_mask = np.asarray(base)
+
+    def add_valid_data(self, valid_data: Dataset, name: str,
+                       metrics: Sequence[Metric]) -> None:
+        data = valid_data.constructed
+        self.valid_scores.append(ScoreUpdater(data, self.num_tree_per_iteration))
+        self.valid_names.append(name)
+        self.valid_metrics.append(list(metrics))
+        self.best_score.append([-math.inf] * len(metrics))
+        self.best_iter.append([0] * len(metrics))
+        self.best_msg.append([""] * len(metrics))
+
+    # -- bagging (`gbdt.cpp:180-241`, `ResetBaggingConfig` `gbdt.cpp:689`) ---
+
+    def _bagging(self, iter_: int) -> None:
+        cfg = self.cfg
+        if cfg.bagging_freq > 0 and cfg.bagging_fraction < 1.0 \
+                and iter_ % cfg.bagging_freq == 0:
+            n = self.num_data
+            bag_cnt = int(cfg.bagging_fraction * n)
+            idx = self._bag_rng.choice(n, bag_cnt, replace=False)
+            mask = np.zeros(self.train_data.num_data_padded, dtype=np.float32)
+            mask[idx] = 1.0
+            self._bag_mask = jnp.asarray(mask)
+            self._np_bag_mask = mask
+            self._bag_cnt = bag_cnt
+
+    def _feature_sample(self) -> jax.Array:
+        """Per-tree feature_fraction sampling (`serial_tree_learner.cpp:255-283`)."""
+        f = self.train_data.num_used_features
+        frac = self.cfg.feature_fraction
+        if frac >= 1.0:
+            return jnp.ones(f, dtype=bool)
+        used = max(1, int(round(f * frac)))
+        idx = self._feat_rng.choice(f, used, replace=False)
+        mask = np.zeros(f, dtype=bool)
+        mask[idx] = True
+        return jnp.asarray(mask)
+
+    # -- gradients -----------------------------------------------------------
+
+    def _compute_gradients(self) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """(K, N_pad) gradients/hessians from the objective (`gbdt.cpp:149`)."""
+        obj = self.objective
+        score = self.train_score.score
+        if obj.name == "multiclass":
+            return obj.get_gradients_all(score)
+        gs, hs = [], []
+        for k in range(self.num_tree_per_iteration):
+            g, h = obj.get_gradients(score[k], k)
+            gs.append(g)
+            hs.append(h)
+        return jnp.stack(gs), jnp.stack(hs)
+
+    # -- one boosting iteration (`gbdt.cpp:333-413`) -------------------------
+
+    def _pad_external_gradients(self, gradients, hessians):
+        grad = jnp.asarray(np.asarray(gradients, dtype=np.float32)
+                           .reshape(self.num_tree_per_iteration, -1))
+        hess = jnp.asarray(np.asarray(hessians, dtype=np.float32)
+                           .reshape(self.num_tree_per_iteration, -1))
+        if grad.shape[1] != self.train_data.num_data_padded:
+            pad = self.train_data.num_data_padded - grad.shape[1]
+            grad = jnp.pad(grad, ((0, 0), (0, pad)))
+            hess = jnp.pad(hess, ((0, 0), (0, pad)))
+        return grad, hess
+
+    def train_one_iter(self, gradients: Optional[np.ndarray] = None,
+                       hessians: Optional[np.ndarray] = None) -> bool:
+        """Returns True when training cannot continue (no splittable leaves)."""
+        init_scores = [0.0] * self.num_tree_per_iteration
+        if gradients is None or hessians is None:
+            for k in range(self.num_tree_per_iteration):
+                init_scores[k] = self._boost_from_average(k, update_scorer=True)
+            grad, hess = self._compute_gradients()
+        else:
+            grad, hess = self._pad_external_gradients(gradients, hessians)
+        self._bagging(self.iter_)
+        return self._train_trees(grad, hess, init_scores)
+
+    def _train_trees(self, grad, hess, init_scores) -> bool:
+        """Per-class tree loop shared by GBDT/GOSS/DART
+        (`gbdt.cpp:348-413`)."""
+        should_continue = False
+        for k in range(self.num_tree_per_iteration):
+            new_tree = Tree(2)
+            leaf_id = None
+            if self.class_need_train[k] and self.train_data.num_used_features > 0:
+                fmask = self._feature_sample()
+                new_tree, leaf_id = self.learner.train(
+                    grad[k], hess[k], self._bag_mask, fmask)
+            if new_tree.num_leaves > 1:
+                should_continue = True
+                if self.objective is not None:
+                    score_np = np.asarray(self.train_score.score[k])
+                    self.objective.renew_tree_output(
+                        new_tree, score_np[:self.num_data],
+                        leaf_id, self._np_bag_mask)
+                new_tree.apply_shrinkage(self.shrinkage_rate)
+                self.train_score.add_by_leaf_id(
+                    new_tree.leaf_value[:new_tree.num_leaves], leaf_id, k)
+                for vs in self.valid_scores:
+                    vs.add_by_tree(new_tree, k)
+                if abs(init_scores[k]) > kEpsilon:
+                    new_tree.leaf_value[:new_tree.num_leaves] += init_scores[k]
+                    new_tree.shrinkage = 1.0
+            else:
+                # constant tree for the never-trained / unsplittable case
+                if len(self.models) < self.num_tree_per_iteration:
+                    if not self.class_need_train[k] and self.objective is not None:
+                        output = self.objective.boost_from_score(k)
+                    else:
+                        output = init_scores[k]
+                    new_tree = Tree(2)
+                    new_tree.num_leaves = 1
+                    new_tree.leaf_value[0] = output
+                    self.train_score.add_constant(output, k)
+                    for vs in self.valid_scores:
+                        vs.add_constant(output, k)
+            self.models.append(new_tree)
+
+        if not should_continue:
+            import warnings
+            warnings.warn("Stopped training because there are no more leaves "
+                          "that meet the split requirements")
+            if len(self.models) > self.num_tree_per_iteration:
+                del self.models[-self.num_tree_per_iteration:]
+            return True
+        self.iter_ += 1
+        return False
+
+    def _boost_from_average(self, class_id: int, update_scorer: bool) -> float:
+        """`gbdt.cpp:309-331`."""
+        if self.models or self.train_score.has_init_score or self.objective is None:
+            return 0.0
+        if not (self.cfg.boost_from_average or self.train_data.num_used_features == 0):
+            return 0.0
+        init_score = self.objective.boost_from_score(class_id)
+        if abs(init_score) > kEpsilon:
+            if update_scorer:
+                self.train_score.add_constant(init_score, class_id)
+                for vs in self.valid_scores:
+                    vs.add_constant(init_score, class_id)
+            return init_score
+        return 0.0
+
+    # -- full training loop (`gbdt.cpp:243-261`) -----------------------------
+
+    def train(self, snapshot_freq: int = -1, model_output_path: str = "",
+              log_fn: Optional[Callable[[str], None]] = None) -> None:
+        log = log_fn or (lambda s: print(f"[LightGBM-TPU] [Info] {s}")
+                         if self.cfg.verbosity >= 1 else None)
+        start = time.time()
+        finished = False
+        for it in range(self.cfg.num_iterations):
+            if finished:
+                break
+            finished = self.train_one_iter()
+            if not finished:
+                finished = self.eval_and_check_early_stopping(log)
+            if log:
+                log(f"{time.time()-start:.6f} seconds elapsed, finished "
+                    f"iteration {it + 1}")
+            if snapshot_freq > 0 and (it + 1) % snapshot_freq == 0:
+                self.save_model_to_file(
+                    f"{model_output_path}.snapshot_iter_{it + 1}")
+
+    # -- eval / early stop (`gbdt.cpp:432-533`) ------------------------------
+
+    def eval_and_check_early_stopping(self, log=None) -> bool:
+        msg = self.output_metric(self.iter_, log)
+        if msg:
+            if log:
+                log(f"Early stopping at iteration {self.iter_}, the best "
+                    f"iteration round is {self.iter_ - self.cfg.early_stopping_round}")
+            drop = self.cfg.early_stopping_round * self.num_tree_per_iteration
+            del self.models[-drop:]
+            return True
+        return False
+
+    def output_metric(self, iter_: int, log=None) -> str:
+        cfg = self.cfg
+        need_output = (iter_ % cfg.metric_freq) == 0
+        ret = ""
+        msg_lines: List[str] = []
+        if need_output:
+            for m in self.training_metrics:
+                for name, val in m.eval(self._metric_score(self.train_score),
+                                        self.objective):
+                    line = f"Iteration:{iter_}, training {name} : {val:g}"
+                    if log:
+                        log(line)
+                    self.eval_history.setdefault("training", {}).setdefault(
+                        name, []).append(val)
+                    if cfg.early_stopping_round > 0:
+                        msg_lines.append(line)
+        meet = []
+        if need_output or cfg.early_stopping_round > 0:
+            for i, metrics in enumerate(self.valid_metrics):
+                for j, m in enumerate(metrics):
+                    results = m.eval(self._metric_score(self.valid_scores[i]),
+                                     self.objective)
+                    dname = self.valid_names[i]
+                    for name, val in results:
+                        line = f"Iteration:{iter_}, valid_{i+1} {name} : {val:g}"
+                        if need_output and log:
+                            log(line)
+                        self.eval_history.setdefault(dname, {}).setdefault(
+                            name, []).append(val)
+                        if cfg.early_stopping_round > 0:
+                            msg_lines.append(line)
+                    if not ret and cfg.early_stopping_round > 0:
+                        factor = 1.0 if m.is_higher_better else -1.0
+                        cur = factor * results[-1][1]
+                        if cur > self.best_score[i][j]:
+                            self.best_score[i][j] = cur
+                            self.best_iter[i][j] = iter_
+                            meet.append((i, j))
+                        elif iter_ - self.best_iter[i][j] >= cfg.early_stopping_round:
+                            ret = self.best_msg[i][j]
+        for i, j in meet:
+            self.best_msg[i][j] = "\n".join(msg_lines)
+        return ret
+
+    def _metric_score(self, updater: ScoreUpdater) -> np.ndarray:
+        return updater.np_score()
+
+    # -- prediction ----------------------------------------------------------
+
+    def predict_raw(self, X: np.ndarray, num_iteration: int = -1) -> np.ndarray:
+        X = np.ascontiguousarray(X, dtype=np.float64)
+        n = X.shape[0]
+        k = self.num_tree_per_iteration
+        out = np.zeros((n, k), dtype=np.float64)
+        num_models = self._num_models_for(num_iteration)
+        for i in range(num_models):
+            out[:, i % k] += self.models[i].predict(X)
+        return out[:, 0] if k == 1 else out
+
+    def predict(self, X: np.ndarray, num_iteration: int = -1,
+                raw_score: bool = False, pred_leaf: bool = False) -> np.ndarray:
+        if pred_leaf:
+            num_models = self._num_models_for(num_iteration)
+            X = np.ascontiguousarray(X, dtype=np.float64)
+            return np.stack([self.models[i].predict_leaf_index(X)
+                             for i in range(num_models)], axis=1)
+        raw = self.predict_raw(X, num_iteration)
+        if raw_score or self.objective is None:
+            return raw
+        return self.objective.convert_output(raw)
+
+    def _num_models_for(self, num_iteration: int) -> int:
+        if num_iteration <= 0:
+            return len(self.models)
+        return min(len(self.models),
+                   num_iteration * self.num_tree_per_iteration)
+
+    @property
+    def num_iterations_trained(self) -> int:
+        return len(self.models) // max(self.num_tree_per_iteration, 1)
+
+    def rollback_one_iter(self) -> None:
+        """`gbdt.cpp:414-431` — drop the last iteration's trees and undo their
+        score contribution."""
+        if self.iter_ <= 0:
+            return
+        for k in range(self.num_tree_per_iteration):
+            idx = len(self.models) - self.num_tree_per_iteration + k
+            tree = self.models[idx]
+            tree.apply_shrinkage(-1.0)
+            if tree.num_leaves > 1:
+                delta = _traverse_tree_binned(self.train_data, tree)
+                self.train_score.score = self.train_score.score.at[k].add(delta)
+                for vs in self.valid_scores:
+                    vs.add_by_tree(tree, k)
+            else:
+                self.train_score.add_constant(float(tree.leaf_value[0]), k)
+                for vs in self.valid_scores:
+                    vs.add_constant(float(tree.leaf_value[0]), k)
+        del self.models[-self.num_tree_per_iteration:]
+        self.iter_ -= 1
+
+    # -- serialization (`gbdt_model_text.cpp:244-341`) -----------------------
+
+    def save_model_to_string(self, start_iteration: int = 0,
+                             num_iteration: int = -1) -> str:
+        out = [self.name]
+        out.append(f"version={K_MODEL_VERSION}")
+        out.append(f"num_class={max(self.cfg.num_class, 1)}")
+        out.append(f"num_tree_per_iteration={self.num_tree_per_iteration}")
+        out.append(f"label_index={self.label_idx}")
+        out.append(f"max_feature_idx={self.max_feature_idx}")
+        if self.objective is not None:
+            out.append(f"objective={self.objective.to_string()}")
+        if self.average_output:
+            out.append("average_output")
+        out.append("feature_names=" + " ".join(self.feature_names))
+        out.append("feature_infos=" + " ".join(self.feature_infos))
+
+        num_used = len(self.models)
+        total_iter = num_used // max(self.num_tree_per_iteration, 1)
+        start_iteration = min(max(start_iteration, 0), total_iter)
+        if num_iteration > 0:
+            num_used = min((start_iteration + num_iteration)
+                           * self.num_tree_per_iteration, num_used)
+        start_model = start_iteration * self.num_tree_per_iteration
+        tree_strs = []
+        for i in range(start_model, num_used):
+            s = f"Tree={i - start_model}\n" + self.models[i].to_string() + "\n"
+            tree_strs.append(s)
+        out.append("tree_sizes=" + " ".join(str(len(s)) for s in tree_strs))
+        out.append("")
+        body = "\n".join(out) + "\n" + "".join(tree_strs)
+        body += "end of trees\n"
+        imps = self.feature_importance("split")
+        pairs = [(int(v), self.feature_names[i]) for i, v in enumerate(imps) if v > 0]
+        pairs.sort(key=lambda p: -p[0])
+        body += "\nfeature importances:\n"
+        for v, name in pairs:
+            body += f"{name}={v}\n"
+        return body
+
+    def save_model_to_file(self, filename: str, start_iteration: int = 0,
+                           num_iteration: int = -1) -> None:
+        with open(filename, "w") as fh:
+            fh.write(self.save_model_to_string(start_iteration, num_iteration))
+
+    def load_model_from_string(self, s: str) -> "GBDT":
+        """`gbdt_model_text.cpp:343-440`."""
+        lines, trees_part = s.split("tree_sizes=", 1)
+        header: Dict[str, str] = {}
+        for line in lines.strip().split("\n"):
+            if "=" in line:
+                k, v = line.split("=", 1)
+                header[k] = v
+            elif line.strip() == "average_output":
+                self.average_output = True
+        self.num_tree_per_iteration = int(header.get("num_tree_per_iteration", 1))
+        self.cfg.num_class = int(header.get("num_class", 1))
+        self.label_idx = int(header.get("label_index", 0))
+        self.max_feature_idx = int(header.get("max_feature_idx", 0))
+        self.feature_names = header.get("feature_names", "").split()
+        self.feature_infos = header.get("feature_infos", "").split()
+        if "objective" in header and self.objective is None:
+            obj_str = header["objective"]
+            self.cfg.objective = _objective_from_string(obj_str, self.cfg)
+            self.objective = create_objective(self.cfg)
+        self.models = []
+        body = trees_part.split("\n", 1)[1]
+        for block in body.split("Tree=")[1:]:
+            tree_txt = block.split("\n\n")[0]
+            tree_txt = tree_txt.split("end of trees")[0]
+            tree_txt = tree_txt.split("\n", 1)[1]  # drop the tree index line
+            self.models.append(Tree.from_string(tree_txt))
+        self.iter_ = len(self.models) // max(self.num_tree_per_iteration, 1)
+        return self
+
+    # -- importances (`boosting.h:224`, `gbdt.cpp` FeatureImportance) --------
+
+    def feature_importance(self, importance_type: str = "split",
+                           num_iteration: int = -1) -> np.ndarray:
+        num_models = self._num_models_for(num_iteration)
+        out = np.zeros(self.max_feature_idx + 1, dtype=np.float64)
+        for i in range(num_models):
+            t = self.models[i]
+            for nd in range(t.num_leaves - 1):
+                if importance_type == "split":
+                    out[t.split_feature[nd]] += 1.0
+                else:
+                    out[t.split_feature[nd]] += max(t.split_gain[nd], 0.0)
+        return out
+
+
+def _feature_infos(data: _ConstructedDataset) -> List[str]:
+    """``feature_infos`` strings: [min:max] per feature or categorical list
+    (`dataset.cpp` SaveModelToString feature info)."""
+    out = ["none"] * data.num_total_features
+    for k, m in enumerate(data.bin_mappers):
+        j = int(data.used_feature_map[k])
+        if m.bin_type == 1:
+            out[j] = ":".join(str(c) for c in m.bin_2_categorical)
+        else:
+            out[j] = f"[{m.min_val:g}:{m.max_val:g}]"
+    return out
+
+
+def _objective_from_string(s: str, cfg: Config) -> str:
+    parts = s.split()
+    name = parts[0]
+    for tok in parts[1:]:
+        if ":" in tok:
+            k, v = tok.split(":", 1)
+            try:
+                setattr(cfg, k, type(getattr(cfg, k, 0.0))(v))
+            except Exception:
+                pass
+    return {"xentropy": "cross_entropy", "xentlambda": "cross_entropy_lambda"
+            }.get(name, name)

@@ -1,0 +1,77 @@
+"""GOSS — gradient-based one-side sampling (`src/boosting/goss.hpp:26-200`).
+
+Keep the top ``top_rate`` fraction of rows by |grad·hess|, sample
+``other_rate`` of the rest uniformly and amplify their gradients by
+``(1-top_rate)/other_rate`` so histogram sums stay unbiased.  The reference
+builds an index subset; here sampling is a device-side mask and the
+amplification is folded into the gradients before tree construction — the
+cnt histogram channel still counts real rows because the bagging mask stays
+0/1.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from .gbdt import GBDT
+
+
+class GOSS(GBDT):
+    name = "goss"
+
+    def init(self, train_data, objective, training_metrics=()):
+        cfg = self.cfg
+        if not (cfg.top_rate + cfg.other_rate <= 1.0
+                and cfg.top_rate > 0 and cfg.other_rate > 0):
+            raise ValueError("top_rate + other_rate must be in (0, 1] with both "
+                             "positive for GOSS")
+        if cfg.bagging_freq > 0 and cfg.bagging_fraction != 1.0:
+            raise ValueError("Cannot use bagging in GOSS")
+        super().init(train_data, objective, training_metrics)
+        self._goss_rng = np.random.RandomState(cfg.bagging_seed)
+        self._amplified = None
+
+    def _bagging(self, iter_):  # sampling handled in train_one_iter
+        pass
+
+    def train_one_iter(self, gradients=None, hessians=None) -> bool:
+        init_scores = [0.0] * self.num_tree_per_iteration
+        if gradients is None or hessians is None:
+            for k in range(self.num_tree_per_iteration):
+                init_scores[k] = self._boost_from_average(k, update_scorer=True)
+            grad, hess = self._compute_gradients()
+        else:
+            grad, hess = self._pad_external_gradients(gradients, hessians)
+
+        cfg = self.cfg
+        n = self.num_data
+        # not subsampled for the first 1/learning_rate iterations
+        # (`goss.hpp:139-141`)
+        if self.iter_ >= int(1.0 / cfg.learning_rate):
+            mag = jnp.sum(jnp.abs(grad * hess), axis=0)
+            mag = np.asarray(mag)[:n]
+            top_k = max(1, int(n * cfg.top_rate))
+            other_k = max(1, int(n * cfg.other_rate))
+            order = np.argsort(-mag, kind="stable")
+            top_idx = order[:top_k]
+            rest_idx = order[top_k:]
+            sampled = self._goss_rng.choice(
+                len(rest_idx), min(other_k, len(rest_idx)), replace=False)
+            other_idx = rest_idx[sampled]
+            multiply = (n - top_k) / other_k
+            mask = np.zeros(self.train_data.num_data_padded, dtype=np.float32)
+            mask[top_idx] = 1.0
+            mask[other_idx] = 1.0
+            amp = np.ones(self.train_data.num_data_padded, dtype=np.float32)
+            amp[other_idx] = multiply
+            self._bag_mask = jnp.asarray(mask)
+            self._np_bag_mask = mask
+            amp_d = jnp.asarray(amp)[None, :]
+            grad = grad * amp_d
+            hess = hess * amp_d
+        else:
+            self._bag_mask = self._valid_rows
+            self._np_bag_mask = np.asarray(self._valid_rows)
+
+        return self._train_trees(grad, hess, init_scores)

@@ -1,0 +1,109 @@
+"""Random forest mode (`src/boosting/rf.hpp:18-180`).
+
+Bagged trees fit once against the init-score gradients (no boosting), no
+shrinkage, averaged output (``average_output``): the running score is kept as
+the average of trees so metrics see the ensemble mean.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..binning import kEpsilon
+from ..tree import Tree
+from .gbdt import GBDT
+
+
+class RF(GBDT):
+    name = "rf"
+
+    def init(self, train_data, objective, training_metrics=()):
+        cfg = self.cfg
+        if not (cfg.bagging_freq > 0 and 0.0 < cfg.bagging_fraction < 1.0):
+            raise ValueError("RF mode requires bagging "
+                             "(bagging_freq > 0 and bagging_fraction in (0,1))")
+        if not (0.0 < cfg.feature_fraction <= 1.0):
+            raise ValueError("RF mode requires feature_fraction in (0, 1]")
+        super().init(train_data, objective, training_metrics)
+        self.average_output = True
+        self.shrinkage_rate = 1.0
+        # gradients are computed once from the constant init score (`rf.hpp:76-95`)
+        self.init_scores = [
+            (self.objective.boost_from_score(k)
+             if (self.objective is not None and cfg.boost_from_average) else 0.0)
+            for k in range(self.num_tree_per_iteration)]
+        n_pad = self.train_data.num_data_padded
+        self._rf_grad = []
+        self._rf_hess = []
+        for k in range(self.num_tree_per_iteration):
+            const_score = jnp.full(n_pad, np.float32(self.init_scores[k]))
+            if self.objective.name == "multiclass":
+                continue
+            g, h = self.objective.get_gradients(const_score, k)
+            self._rf_grad.append(g)
+            self._rf_hess.append(h)
+        if self.objective is not None and self.objective.name == "multiclass":
+            const = jnp.stack([jnp.full(n_pad, np.float32(s))
+                               for s in self.init_scores])
+            g, h = self.objective.get_gradients_all(const)
+            self._rf_grad = [g[k] for k in range(self.num_tree_per_iteration)]
+            self._rf_hess = [h[k] for k in range(self.num_tree_per_iteration)]
+
+    def _multiply_score(self, class_id: int, factor: float) -> None:
+        self.train_score.score = self.train_score.score.at[class_id].multiply(
+            np.float32(factor))
+        for vs in self.valid_scores:
+            vs.score = vs.score.at[class_id].multiply(np.float32(factor))
+
+    def train_one_iter(self, gradients=None, hessians=None) -> bool:
+        self._bagging(self.iter_)
+        should_continue = False
+        for k in range(self.num_tree_per_iteration):
+            new_tree = Tree(2)
+            leaf_id = None
+            if self.class_need_train[k]:
+                fmask = self._feature_sample()
+                new_tree, leaf_id = self.learner.train(
+                    self._rf_grad[k], self._rf_hess[k], self._bag_mask, fmask)
+            if new_tree.num_leaves > 1:
+                should_continue = True
+                if self.objective is not None:
+                    const_score = np.full(self.num_data,
+                                          self.init_scores[k], dtype=np.float64)
+                    self.objective.renew_tree_output(
+                        new_tree, const_score, leaf_id, self._np_bag_mask)
+                if abs(self.init_scores[k]) > kEpsilon:
+                    new_tree.leaf_value[:new_tree.num_leaves] += self.init_scores[k]
+                # running average of tree outputs (`rf.hpp:131-134`)
+                self._multiply_score(k, self.iter_)
+                self.train_score.add_by_leaf_id(
+                    new_tree.leaf_value[:new_tree.num_leaves], leaf_id, k)
+                for vs in self.valid_scores:
+                    vs.add_by_tree(new_tree, k)
+                self._multiply_score(k, 1.0 / (self.iter_ + 1))
+            else:
+                if len(self.models) < self.num_tree_per_iteration:
+                    output = (self.objective.boost_from_score(k)
+                              if (self.objective is not None
+                                  and not self.class_need_train[k])
+                              else self.init_scores[k])
+                    new_tree = Tree(2)
+                    new_tree.num_leaves = 1
+                    new_tree.leaf_value[0] = output
+                    self.train_score.add_constant(output, k)
+                    for vs in self.valid_scores:
+                        vs.add_constant(output, k)
+            self.models.append(new_tree)
+        if not should_continue:
+            if len(self.models) > self.num_tree_per_iteration:
+                del self.models[-self.num_tree_per_iteration:]
+            return True
+        self.iter_ += 1
+        return False
+
+    def predict_raw(self, X, num_iteration: int = -1):
+        raw = super().predict_raw(X, num_iteration)
+        n_iter = self._num_models_for(num_iteration) // max(
+            self.num_tree_per_iteration, 1)
+        return raw / max(n_iter, 1)

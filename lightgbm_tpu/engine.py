@@ -1,0 +1,410 @@
+"""Training engine: ``train`` / ``cv`` and the ``Booster`` facade.
+
+Mirrors the reference python package (`python-package/lightgbm/engine.py:19-447`
+``train``/``cv`` and `basic.py:1577+` ``Booster``): same signatures, callback
+protocol (``CallbackEnv``), early stopping and evaluation-history semantics,
+so user code written against the reference's ``lgb.train`` runs unchanged.
+"""
+
+from __future__ import annotations
+
+import collections
+import copy
+import warnings
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from . import callback as callback_mod
+from .boosting import create_boosting
+from .boosting.gbdt import GBDT
+from .config import Config
+from .dataset import Dataset
+from .metrics import create_metric
+from .objectives import create_objective
+
+
+class Booster:
+    """User-facing booster handle (`python-package/lightgbm/basic.py:1577`)."""
+
+    def __init__(self, params: Optional[Dict] = None,
+                 train_set: Optional[Dataset] = None,
+                 model_file: Optional[str] = None,
+                 model_str: Optional[str] = None):
+        params = dict(params or {})
+        self.params = params
+        self.cfg = Config.from_params(params)
+        self.best_iteration = -1
+        self.best_score: Dict[str, Dict[str, float]] = {}
+        self._train_set = train_set
+        self.gbdt: Optional[GBDT] = None
+        if train_set is not None:
+            train_set.construct()
+            objective = create_objective(self.cfg)
+            self.gbdt = create_boosting(self.cfg)
+            train_metrics = []
+            if self.cfg.is_provide_training_metric:
+                train_metrics = self._make_metrics(train_set)
+            self.gbdt.init(train_set, objective, train_metrics)
+        elif model_file is not None:
+            with open(model_file) as fh:
+                self._load_from_string(fh.read())
+        elif model_str is not None:
+            self._load_from_string(model_str)
+        else:
+            raise ValueError("At least one of params/train_set, model_file "
+                             "or model_str should be provided")
+
+    def _load_from_string(self, s: str) -> None:
+        self.gbdt = GBDT(self.cfg)
+        self.gbdt.load_model_from_string(s)
+
+    def _make_metrics(self, dataset: Dataset):
+        metrics = []
+        for name in self.cfg.metric:
+            m = create_metric(name, self.cfg)
+            if m is not None:
+                m.init(dataset.constructed.metadata, dataset.constructed.num_data)
+                metrics.append(m)
+        return metrics
+
+    # -- training-side API ---------------------------------------------------
+
+    def add_valid(self, data: Dataset, name: str) -> "Booster":
+        data.construct()
+        self.gbdt.add_valid_data(data, name, self._make_metrics(data))
+        return self
+
+    def update(self, train_set: Optional[Dataset] = None,
+               fobj: Optional[Callable] = None) -> bool:
+        """One boosting iteration (`basic.py:1842`); returns True if training
+        should stop."""
+        if fobj is None:
+            return self.gbdt.train_one_iter()
+        grad, hess = fobj(self._curr_preds(), self._train_set)
+        return self.__boost(grad, hess)
+
+    def __boost(self, grad: np.ndarray, hess: np.ndarray) -> bool:
+        return self.gbdt.train_one_iter(grad, hess)
+
+    def _curr_preds(self) -> np.ndarray:
+        return self.gbdt.train_score.np_score()
+
+    def rollback_one_iter(self) -> "Booster":
+        self.gbdt.rollback_one_iter()
+        return self
+
+    @property
+    def current_iteration(self) -> int:
+        return self.gbdt.iter_
+
+    def num_trees(self) -> int:
+        return len(self.gbdt.models)
+
+    # -- evaluation ----------------------------------------------------------
+
+    def eval_train(self, feval=None) -> List[Tuple]:
+        return self._eval_set("training", self.gbdt.train_score,
+                              self.gbdt.training_metrics, feval,
+                              self._train_set)
+
+    def eval_valid(self, feval=None) -> List[Tuple]:
+        out = []
+        for i, name in enumerate(self.gbdt.valid_names):
+            out.extend(self._eval_set(name, self.gbdt.valid_scores[i],
+                                      self.gbdt.valid_metrics[i], feval, None))
+        return out
+
+    def _eval_set(self, name, updater, metrics, feval, dataset) -> List[Tuple]:
+        results = []
+        score = updater.np_score()
+        for m in metrics:
+            for mname, val in m.eval(score, self.gbdt.objective):
+                results.append((name, mname, val, m.is_higher_better))
+        if feval is not None:
+            ds = dataset if dataset is not None else None
+            fname, fval, higher_better = feval(score, ds)
+            results.append((name, fname, fval, higher_better))
+        # keep the per-iteration history that cv()/sklearn evals_result_ read
+        for dname, mname, val, _ in results:
+            self.gbdt.eval_history.setdefault(dname, {}).setdefault(
+                mname, []).append(val)
+        return results
+
+    # -- prediction / persistence -------------------------------------------
+
+    def predict(self, data, num_iteration: int = -1, raw_score: bool = False,
+                pred_leaf: bool = False, pred_contrib: bool = False,
+                **kwargs) -> np.ndarray:
+        if hasattr(data, "values") and not isinstance(data, np.ndarray):
+            data = data.values
+        data = np.asarray(data, dtype=np.float64)
+        if pred_contrib:
+            from .contrib import predict_contrib
+            return predict_contrib(self.gbdt, data, num_iteration)
+        return self.gbdt.predict(data, num_iteration, raw_score, pred_leaf)
+
+    def save_model(self, filename: str, num_iteration: int = -1,
+                   start_iteration: int = 0) -> "Booster":
+        if num_iteration < 0:
+            num_iteration = self.best_iteration if self.best_iteration > 0 else -1
+        self.gbdt.save_model_to_file(filename, start_iteration, num_iteration)
+        return self
+
+    def model_to_string(self, num_iteration: int = -1,
+                        start_iteration: int = 0) -> str:
+        if num_iteration < 0:
+            num_iteration = self.best_iteration if self.best_iteration > 0 else -1
+        return self.gbdt.save_model_to_string(start_iteration, num_iteration)
+
+    def feature_importance(self, importance_type: str = "split",
+                           iteration: int = -1) -> np.ndarray:
+        return self.gbdt.feature_importance(importance_type, iteration)
+
+    def feature_name(self) -> List[str]:
+        return list(self.gbdt.feature_names)
+
+    def num_feature(self) -> int:
+        return self.gbdt.max_feature_idx + 1
+
+    def __getstate__(self):
+        state = {"model_str": self.model_to_string(num_iteration=-1),
+                 "params": self.params,
+                 "best_iteration": self.best_iteration,
+                 "best_score": self.best_score}
+        return state
+
+    def __setstate__(self, state):
+        self.params = state["params"]
+        self.cfg = Config.from_params(self.params)
+        self.best_iteration = state["best_iteration"]
+        self.best_score = state["best_score"]
+        self._train_set = None
+        self._load_from_string(state["model_str"])
+
+
+def train(params: Dict, train_set: Dataset, num_boost_round: int = 100,
+          valid_sets: Optional[Sequence[Dataset]] = None,
+          valid_names: Optional[Sequence[str]] = None,
+          fobj: Optional[Callable] = None, feval: Optional[Callable] = None,
+          init_model: Optional[Union[str, Booster]] = None,
+          feature_name: str = "auto", categorical_feature: str = "auto",
+          early_stopping_rounds: Optional[int] = None,
+          evals_result: Optional[Dict] = None, verbose_eval=True,
+          learning_rates=None, keep_training_booster: bool = False,
+          callbacks: Optional[List[Callable]] = None) -> Booster:
+    """`python-package/lightgbm/engine.py:19-245` semantics."""
+    params = dict(params or {})
+    cfg_probe = Config.from_params(params)
+    if "num_iterations" not in params and num_boost_round is not None:
+        params["num_iterations"] = num_boost_round
+    num_boost_round = Config.from_params(params).num_iterations
+    if fobj is not None:
+        params["objective"] = "none"
+
+    train_set.params = {**params, **(train_set.params or {})}
+    if feature_name != "auto":
+        train_set.feature_name = feature_name
+    if categorical_feature != "auto":
+        train_set.categorical_feature = categorical_feature
+
+    booster = Booster(params=params, train_set=train_set)
+    if init_model is not None:
+        init_booster = init_model if isinstance(init_model, Booster) else \
+            Booster(model_file=init_model, params=params)
+        _continue_training(booster, init_booster)
+
+    valid_sets = list(valid_sets or [])
+    names = []
+    for i, vs in enumerate(valid_sets):
+        if vs is train_set:
+            continue
+        name = (valid_names[i] if valid_names and i < len(valid_names)
+                else f"valid_{i}")
+        booster.add_valid(vs, name)
+        names.append(name)
+
+    callbacks = list(callbacks or [])
+    if verbose_eval is True:
+        callbacks.append(callback_mod.print_evaluation())
+    elif isinstance(verbose_eval, int) and verbose_eval >= 1:
+        callbacks.append(callback_mod.print_evaluation(verbose_eval))
+    if early_stopping_rounds is not None and early_stopping_rounds > 0:
+        callbacks.append(callback_mod.early_stopping(
+            early_stopping_rounds, verbose=bool(verbose_eval)))
+    if evals_result is not None:
+        callbacks.append(callback_mod.record_evaluation(evals_result))
+    if learning_rates is not None:
+        callbacks.append(callback_mod.reset_parameter(
+            learning_rate=learning_rates))
+    callbacks_before = [cb for cb in callbacks
+                        if getattr(cb, "before_iteration", False)]
+    callbacks_after = [cb for cb in callbacks
+                       if not getattr(cb, "before_iteration", False)]
+    callbacks_before.sort(key=lambda cb: getattr(cb, "order", 0))
+    callbacks_after.sort(key=lambda cb: getattr(cb, "order", 0))
+
+    init_iter = booster.current_iteration
+    for i in range(init_iter, init_iter + num_boost_round):
+        env = callback_mod.CallbackEnv(
+            model=booster, params=params, iteration=i,
+            begin_iteration=init_iter,
+            end_iteration=init_iter + num_boost_round,
+            evaluation_result_list=None)
+        for cb in callbacks_before:
+            cb(env)
+        finished = booster.update(fobj=fobj)
+        evaluation_result_list = []
+        if booster.gbdt.valid_metrics or booster.gbdt.training_metrics or feval:
+            if booster.gbdt.training_metrics or (
+                    feval and cfg_probe.is_provide_training_metric):
+                evaluation_result_list.extend(booster.eval_train(feval))
+            evaluation_result_list.extend(booster.eval_valid(feval))
+        env = env._replace(evaluation_result_list=evaluation_result_list)
+        try:
+            for cb in callbacks_after:
+                cb(env)
+        except callback_mod.EarlyStopException as es:
+            booster.best_iteration = es.best_iteration + 1
+            for name, mname, val, _ in es.best_score:
+                booster.best_score.setdefault(name, {})[mname] = val
+            break
+        if finished:
+            break
+    if booster.best_iteration <= 0:
+        for name, mname, val, _ in (evaluation_result_list or []):
+            booster.best_score.setdefault(name, {})[mname] = val
+    return booster
+
+
+def _continue_training(booster: Booster, init_booster: Booster) -> None:
+    """Continue-training: seed models and replay their scores
+    (`boosting.cpp:43-62`, `application.cpp:88-93` init-score threading)."""
+    from .boosting.gbdt import _traverse_tree_binned, rebind_tree_to_dataset
+    gbdt = booster.gbdt
+    src = init_booster.gbdt
+    gbdt.models = [copy.deepcopy(t) for t in src.models]
+    gbdt.num_tree_per_iteration = src.num_tree_per_iteration
+    gbdt.iter_ = len(gbdt.models) // max(gbdt.num_tree_per_iteration, 1)
+    for tree in gbdt.models:
+        rebind_tree_to_dataset(tree, gbdt.train_data)
+    for idx, tree in enumerate(gbdt.models):
+        k = idx % gbdt.num_tree_per_iteration
+        if tree.num_leaves > 1:
+            delta = _traverse_tree_binned(gbdt.train_data, tree)
+            gbdt.train_score.score = gbdt.train_score.score.at[k].add(delta)
+            for vs in gbdt.valid_scores:
+                vs.add_by_tree(tree, k)
+        else:
+            gbdt.train_score.add_constant(float(tree.leaf_value[0]), k)
+            for vs in gbdt.valid_scores:
+                vs.add_constant(float(tree.leaf_value[0]), k)
+    gbdt.train_score.has_init_score = True
+
+
+class CVBooster:
+    def __init__(self):
+        self.boosters: List[Booster] = []
+        self.best_iteration = -1
+
+    def _append(self, booster):
+        self.boosters.append(booster)
+
+    def __getattr__(self, name):
+        def handler(*args, **kwargs):
+            return [getattr(b, name)(*args, **kwargs) for b in self.boosters]
+        return handler
+
+
+def cv(params: Dict, train_set: Dataset, num_boost_round: int = 100,
+       folds=None, nfold: int = 5, stratified: bool = True, shuffle: bool = True,
+       metrics=None, fobj=None, feval=None, init_model=None,
+       feature_name="auto", categorical_feature="auto",
+       early_stopping_rounds=None, fpreproc=None, verbose_eval=None,
+       show_stdv: bool = True, seed: int = 0, callbacks=None,
+       eval_train_metric: bool = False) -> Dict[str, List[float]]:
+    """K-fold cross-validation (`engine.py:334-447`)."""
+    params = dict(params or {})
+    if metrics is not None:
+        params["metric"] = metrics
+    train_set.construct()
+    full = train_set
+    n = full.num_data()
+    label = np.asarray(full.get_label())
+    rng = np.random.RandomState(seed)
+    if folds is None:
+        idx = np.arange(n)
+        if stratified and Config.from_params(params).objective in (
+                "binary", "multiclass", "multiclassova"):
+            folds = _stratified_folds(label, nfold, rng, shuffle)
+        else:
+            if shuffle:
+                rng.shuffle(idx)
+            folds = [(np.setdiff1d(idx, idx[f::nfold], assume_unique=False),
+                      idx[f::nfold]) for f in range(nfold)]
+
+    results = collections.defaultdict(list)
+    cvbooster = CVBooster()
+    raw = full._load_raw(full._raw_data)
+    weights = full.get_weight()
+    for train_idx, test_idx in folds:
+        dtrain = Dataset(raw[train_idx], label=label[train_idx],
+                         weight=None if weights is None else weights[train_idx],
+                         params=params,
+                         categorical_feature=full.categorical_feature)
+        dtest = Dataset(raw[test_idx], label=label[test_idx],
+                        weight=None if weights is None else weights[test_idx],
+                        reference=dtrain, params=params)
+        if fpreproc is not None:
+            dtrain, dtest, params = fpreproc(dtrain, dtest, dict(params))
+        bst = train(params, dtrain, num_boost_round, valid_sets=[dtest],
+                    valid_names=["valid"], fobj=fobj, feval=feval,
+                    verbose_eval=False, callbacks=list(callbacks or []))
+        cvbooster._append(bst)
+    # aggregate per-iteration metrics across folds
+    per_fold = [b.gbdt.eval_history.get("valid", {}) for b in cvbooster.boosters]
+    metric_names = set()
+    for h in per_fold:
+        metric_names.update(h.keys())
+    es_rounds = early_stopping_rounds or 0
+    best_iter = -1
+    for mname in sorted(metric_names):
+        rows = [h.get(mname, []) for h in per_fold]
+        iters = min(len(r) for r in rows)
+        means = [float(np.mean([r[i] for r in rows])) for i in range(iters)]
+        stds = [float(np.std([r[i] for r in rows])) for i in range(iters)]
+        results[f"{mname}-mean"] = means
+        results[f"{mname}-stdv"] = stds
+    if early_stopping_rounds:
+        # truncate at the best mean of the first metric
+        for mname in sorted(metric_names):
+            means = results[f"{mname}-mean"]
+            # assume lower is better unless known otherwise
+            from .metrics import _METRIC_TABLE
+            hb = getattr(_METRIC_TABLE.get(mname.split("@")[0], None),
+                         "is_higher_better", False)
+            arr = np.asarray(means)
+            best = int(np.argmax(arr) if hb else np.argmin(arr))
+            for key in list(results):
+                if key.startswith(mname):
+                    results[key] = results[key][:best + 1]
+            break
+    return dict(results)
+
+
+def _stratified_folds(label, nfold, rng, shuffle):
+    classes = np.unique(label)
+    test_folds = [[] for _ in range(nfold)]
+    for c in classes:
+        idx = np.where(label == c)[0]
+        if shuffle:
+            rng.shuffle(idx)
+        for f in range(nfold):
+            test_folds[f].extend(idx[f::nfold])
+    n = len(label)
+    out = []
+    for f in range(nfold):
+        test = np.asarray(sorted(test_folds[f]))
+        train_idx = np.setdiff1d(np.arange(n), test)
+        out.append((train_idx, test))
+    return out

@@ -1,0 +1,1 @@
+from .parser import load_data_file  # noqa: F401

@@ -1,0 +1,318 @@
+"""TPU tree learner: leaf-wise (best-first) tree growth on device.
+
+TPU-native re-design of ``SerialTreeLearner`` (`src/treelearner/serial_tree_learner.cpp:157-860`)
+slotting in where ``GPUTreeLearner`` does (`src/treelearner/gpu_tree_learner.cpp`).
+The reference's per-split control flow is preserved — keep a best split per
+leaf, split the globally best leaf, build the smaller child's histogram and
+subtract for the sibling (`serial_tree_learner.cpp:371-385`) — but the data
+structures are re-designed for static-shape XLA:
+
+  * ``DataPartition``'s permuted index array (`data_partition.hpp`) becomes a
+    flat ``(rows,) int32 leaf_id`` updated with ``where`` on the split
+    predicate; histogram masking on ``leaf_id == leaf`` replaces row slicing.
+  * The ``HistogramPool`` LRU (`feature_histogram.hpp:646-818`) becomes a
+    dense ``(num_leaves, F, B, 3)`` pool in HBM — no eviction, sized up front.
+  * The entire split becomes ONE jitted ``split_step`` with no data-dependent
+    Python control flow; a step whose best gain is <= 0 is an exact no-op, so
+    a tree is always ``num_leaves - 1`` dispatches and only the tiny per-split
+    record array crosses back to host, once per tree.
+
+Numerics: histograms and gains are f32 (the reference GPU path's documented
+regime, `docs/GPU-Performance.rst:137-141`); per-leaf totals come from f32
+reductions over the bagged mask.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .binning import MISSING_NAN, MISSING_ZERO
+from .config import Config
+from .dataset import _ConstructedDataset
+from .ops.histogram import build_histogram
+from .ops.split import SplitCandidates, find_best_splits
+from .tree import Tree
+
+# per-split record layout fetched to host once per tree
+REC_VALID, REC_LEAF, REC_FEATURE, REC_THRESHOLD, REC_DEFAULT_LEFT, REC_GAIN, \
+    REC_LEFT_OUT, REC_RIGHT_OUT, REC_LEFT_CNT, REC_RIGHT_CNT, \
+    REC_INTERNAL_VALUE, REC_INTERNAL_CNT, REC_LEFT_SUM_H, REC_RIGHT_SUM_H, \
+    REC_LEFT_SUM_G, REC_RIGHT_SUM_G = range(16)
+NUM_REC_FIELDS = 16
+
+
+class TreeState(NamedTuple):
+    leaf_id: jax.Array       # (N,) int32
+    hist_pool: jax.Array     # (L, F, B, 3) f32
+    leaf_sum_g: jax.Array    # (L,) f32
+    leaf_sum_h: jax.Array    # (L,) f32
+    leaf_cnt: jax.Array      # (L,) f32
+    leaf_output: jax.Array   # (L,) f32
+    leaf_depth: jax.Array    # (L,) int32
+    cand: SplitCandidates    # per-leaf best splits, arrays (L,)
+    num_leaves: jax.Array    # () int32
+    records: jax.Array       # (L-1, NUM_REC_FIELDS) f32
+
+
+class _LeafCand(NamedTuple):
+    """Best split per LEAF, reduced over features (fields shape (L,))."""
+    gain: jax.Array
+    feature: jax.Array
+    threshold: jax.Array
+    default_left: jax.Array
+    left_sum_g: jax.Array
+    left_sum_h: jax.Array
+    left_cnt: jax.Array
+    right_sum_g: jax.Array
+    right_sum_h: jax.Array
+    right_cnt: jax.Array
+    left_output: jax.Array
+    right_output: jax.Array
+
+
+def _reduce_over_features(cand: SplitCandidates) -> _LeafCand:
+    """argmax over features; lowest feature index wins ties
+    (`serial_tree_learner.cpp:505-520`)."""
+    best_f = jnp.argmax(cand.gain).astype(jnp.int32)
+    g = lambda a: a[best_f]
+    return _LeafCand(gain=g(cand.gain), feature=best_f,
+                     threshold=g(cand.threshold),
+                     default_left=g(cand.default_left),
+                     left_sum_g=g(cand.left_sum_g), left_sum_h=g(cand.left_sum_h),
+                     left_cnt=g(cand.left_cnt), right_sum_g=g(cand.right_sum_g),
+                     right_sum_h=g(cand.right_sum_h), right_cnt=g(cand.right_cnt),
+                     left_output=g(cand.left_output),
+                     right_output=g(cand.right_output))
+
+
+class TPUTreeLearner:
+    """Leaf-wise growth driven from host: one jitted no-op-able step per
+    split, single host sync per tree (factory slot:
+    `src/treelearner/tree_learner.cpp:9-33`, device_type=tpu)."""
+
+    def __init__(self, cfg: Config, data: _ConstructedDataset,
+                 hist_backend: str = "auto"):
+        self.cfg = cfg
+        self.data = data
+        self.num_leaves = max(int(cfg.num_leaves), 2)
+        self.hist_backend = hist_backend
+        num_bin, missing, default_bin, is_cat = data.feature_meta_arrays()
+        self.f_num_bin = jnp.asarray(num_bin)
+        self.f_missing = jnp.asarray(missing)
+        self.f_default_bin = jnp.asarray(default_bin)
+        self.np_num_bin = num_bin
+        self.np_missing = missing
+        self.np_default_bin = default_bin
+        self.is_categorical = is_cat
+        self.num_bins_padded = int(data.max_num_bin)
+        self.num_features = data.num_used_features
+        self.bins = data.device_bins()
+        self._split_kwargs = dict(
+            lambda_l1=float(cfg.lambda_l1), lambda_l2=float(cfg.lambda_l2),
+            max_delta_step=float(cfg.max_delta_step),
+            min_data_in_leaf=int(cfg.min_data_in_leaf),
+            min_sum_hessian_in_leaf=float(cfg.min_sum_hessian_in_leaf),
+            min_gain_to_split=float(cfg.min_gain_to_split))
+        # categorical features are excluded from the numerical split finder
+        # until the categorical scan lands; combined with user feature masks.
+        self._cat_mask = jnp.asarray(~is_cat)
+        self._jit_init = jax.jit(self._init_root)
+        self._jit_step = jax.jit(self._split_step, donate_argnums=(0,))
+
+    # -- device functions ----------------------------------------------------
+
+    def _hist(self, w):
+        h = build_histogram(self.bins, w, num_bins=self.num_bins_padded,
+                            backend=self.hist_backend)
+        return h[:self.num_features]  # drop feature-tile padding rows
+
+    def _leaf_cand(self, hist, sum_g, sum_h, cnt, feature_mask, depth_ok) -> _LeafCand:
+        cand = find_best_splits(
+            hist, sum_g, sum_h, cnt, self.f_num_bin, self.f_missing,
+            self.f_default_bin, feature_mask & self._cat_mask,
+            **self._split_kwargs)
+        lc = _reduce_over_features(cand)
+        return lc._replace(gain=jnp.where(depth_ok, lc.gain, -jnp.inf))
+
+    def _init_root(self, grad, hess, bag, feature_mask) -> TreeState:
+        n = self.bins.shape[1]
+        f = self.num_features
+        b = self.num_bins_padded
+        L = self.num_leaves
+        w = jnp.stack([grad * bag, hess * bag, bag], axis=0)
+        root_hist = self._hist(w)
+        sum_g = jnp.sum(grad * bag)
+        sum_h = jnp.sum(hess * bag)
+        cnt = jnp.sum(bag)
+        md = int(self.cfg.max_depth)
+        depth_ok = jnp.asarray(True if md <= 0 else md > 0)
+        root = self._leaf_cand(root_hist, sum_g, sum_h, cnt, feature_mask, depth_ok)
+
+        def expand(x):
+            x = jnp.asarray(x)
+            return jnp.concatenate(
+                [x[None], jnp.zeros((L - 1,) + x.shape, x.dtype)], axis=0)
+
+        cand_L = jax.tree_util.tree_map(expand, root)
+        cand_L = cand_L._replace(gain=cand_L.gain.at[1:].set(-jnp.inf))
+        hist_pool = jnp.zeros((L, f, b, 3), jnp.float32).at[0].set(root_hist)
+        return TreeState(
+            leaf_id=jnp.zeros(n, jnp.int32),
+            hist_pool=hist_pool,
+            leaf_sum_g=jnp.zeros(L, jnp.float32).at[0].set(sum_g),
+            leaf_sum_h=jnp.zeros(L, jnp.float32).at[0].set(sum_h),
+            leaf_cnt=jnp.zeros(L, jnp.float32).at[0].set(cnt),
+            leaf_output=jnp.zeros(L, jnp.float32),
+            leaf_depth=jnp.zeros(L, jnp.int32),
+            cand=cand_L,
+            num_leaves=jnp.asarray(1, jnp.int32),
+            records=jnp.zeros((L - 1, NUM_REC_FIELDS), jnp.float32))
+
+    def _split_step(self, state: TreeState, grad, hess, bag, feature_mask,
+                    step_idx) -> TreeState:
+        cfg = self.cfg
+        cand = state.cand
+        best_leaf = jnp.argmax(cand.gain).astype(jnp.int32)
+        best_gain = cand.gain[best_leaf]
+        do = best_gain > 0.0
+        dof = do.astype(jnp.float32)
+
+        info = jax.tree_util.tree_map(lambda a: a[best_leaf], cand)
+        new_leaf = state.num_leaves
+
+        # ---- partition rows (`data_partition.hpp` Split → `tree.h:233-249`
+        # NumericalDecisionInner)
+        frow = self.bins[info.feature]                      # (N,) bin codes
+        frow = frow.astype(jnp.int32)
+        mt = self.f_missing[info.feature]
+        db = self.f_default_bin[info.feature]
+        nb = self.f_num_bin[info.feature]
+        is_missing = ((mt == MISSING_ZERO) & (frow == db)) | \
+                     ((mt == MISSING_NAN) & (frow == nb - 1))
+        go_left = jnp.where(is_missing, info.default_left,
+                            frow <= info.threshold)
+        at_leaf = state.leaf_id == best_leaf
+        leaf_id = jnp.where(do & at_leaf & ~go_left, new_leaf, state.leaf_id)
+
+        # ---- smaller-child histogram + sibling subtraction
+        # (`serial_tree_learner.cpp:371-385`)
+        left_smaller = info.left_cnt <= info.right_cnt
+        small_leaf = jnp.where(left_smaller, best_leaf, new_leaf)
+        m_small = (leaf_id == small_leaf) & at_leaf & do
+        msf = m_small.astype(jnp.float32)
+        w = jnp.stack([grad * bag * msf, hess * bag * msf, bag * msf], axis=0)
+        hist_small = self._hist(w)
+        hist_parent = state.hist_pool[best_leaf]
+        hist_large = hist_parent - hist_small
+        hist_left = jnp.where(left_smaller, hist_small, hist_large)
+        hist_right = jnp.where(left_smaller, hist_large, hist_small)
+        hist_pool = state.hist_pool
+        hist_pool = hist_pool.at[best_leaf].set(
+            jnp.where(do, hist_left, hist_parent))
+        hist_pool = hist_pool.at[new_leaf].set(
+            jnp.where(do, hist_right, hist_pool[new_leaf]))
+
+        # ---- leaf bookkeeping
+        upd = lambda arr, l_val, r_val: (
+            arr.at[best_leaf].set(jnp.where(do, l_val, arr[best_leaf]))
+               .at[new_leaf].set(jnp.where(do, r_val, arr[new_leaf])))
+        leaf_sum_g = upd(state.leaf_sum_g, info.left_sum_g, info.right_sum_g)
+        leaf_sum_h = upd(state.leaf_sum_h, info.left_sum_h, info.right_sum_h)
+        leaf_cnt = upd(state.leaf_cnt, info.left_cnt, info.right_cnt)
+        prev_output = state.leaf_output[best_leaf]
+        leaf_output = upd(state.leaf_output, info.left_output, info.right_output)
+        child_depth = state.leaf_depth[best_leaf] + 1
+        leaf_depth = upd(state.leaf_depth, child_depth, child_depth)
+
+        # ---- children's best splits
+        md = int(cfg.max_depth)
+        depth_ok = jnp.asarray(True) if md <= 0 else (child_depth < md)
+        cand_left = self._leaf_cand(hist_left, info.left_sum_g, info.left_sum_h,
+                                    info.left_cnt, feature_mask, depth_ok)
+        cand_right = self._leaf_cand(hist_right, info.right_sum_g,
+                                     info.right_sum_h, info.right_cnt,
+                                     feature_mask, depth_ok)
+
+        def upd_cand(arr, l_val, r_val):
+            return (arr.at[best_leaf].set(
+                        jnp.where(do, l_val, arr[best_leaf]))
+                       .at[new_leaf].set(
+                        jnp.where(do, r_val, arr[new_leaf])))
+
+        new_cand = jax.tree_util.tree_map(upd_cand, state.cand,
+                                          cand_left, cand_right)
+
+        # ---- record for host-side tree assembly
+        rec = jnp.zeros(NUM_REC_FIELDS, jnp.float32)
+        rec = rec.at[REC_VALID].set(dof)
+        rec = rec.at[REC_LEAF].set(best_leaf.astype(jnp.float32))
+        rec = rec.at[REC_FEATURE].set(info.feature.astype(jnp.float32))
+        rec = rec.at[REC_THRESHOLD].set(info.threshold.astype(jnp.float32))
+        rec = rec.at[REC_DEFAULT_LEFT].set(info.default_left.astype(jnp.float32))
+        rec = rec.at[REC_GAIN].set(best_gain)
+        rec = rec.at[REC_LEFT_OUT].set(info.left_output)
+        rec = rec.at[REC_RIGHT_OUT].set(info.right_output)
+        rec = rec.at[REC_LEFT_CNT].set(info.left_cnt)
+        rec = rec.at[REC_RIGHT_CNT].set(info.right_cnt)
+        rec = rec.at[REC_INTERNAL_VALUE].set(prev_output)
+        rec = rec.at[REC_INTERNAL_CNT].set(state.leaf_cnt[best_leaf])
+        rec = rec.at[REC_LEFT_SUM_H].set(info.left_sum_h)
+        rec = rec.at[REC_RIGHT_SUM_H].set(info.right_sum_h)
+        rec = rec.at[REC_LEFT_SUM_G].set(info.left_sum_g)
+        rec = rec.at[REC_RIGHT_SUM_G].set(info.right_sum_g)
+        records = state.records.at[step_idx].set(rec)
+
+        return TreeState(
+            leaf_id=leaf_id, hist_pool=hist_pool, leaf_sum_g=leaf_sum_g,
+            leaf_sum_h=leaf_sum_h, leaf_cnt=leaf_cnt, leaf_output=leaf_output,
+            leaf_depth=leaf_depth, cand=new_cand,
+            num_leaves=state.num_leaves + do.astype(jnp.int32),
+            records=records)
+
+    # -- host orchestration --------------------------------------------------
+
+    def train(self, grad: jax.Array, hess: jax.Array, bag: jax.Array,
+              feature_mask: Optional[jax.Array] = None
+              ) -> Tuple[Tree, jax.Array]:
+        """Build one tree; returns (host Tree with unit shrinkage, device
+        leaf_id for the score updater)."""
+        f = self.num_features
+        if feature_mask is None:
+            feature_mask = jnp.ones(f, dtype=bool)
+        state = self._jit_init(grad, hess, bag, feature_mask)
+        for i in range(self.num_leaves - 1):
+            state = self._jit_step(state, grad, hess, bag, feature_mask,
+                                   jnp.asarray(i, jnp.int32))
+        records = np.asarray(state.records)  # single host sync per tree
+        tree = self._assemble(records)
+        return tree, state.leaf_id
+
+    def _assemble(self, records: np.ndarray) -> Tree:
+        tree = Tree(self.num_leaves)
+        used_map = self.data.used_feature_map
+        for i in range(records.shape[0]):
+            r = records[i]
+            if r[REC_VALID] < 0.5:
+                break
+            fi = int(r[REC_FEATURE])
+            thr_bin = int(r[REC_THRESHOLD])
+            mapper = self.data.bin_mappers[fi]
+            tree.split(
+                leaf=int(r[REC_LEAF]), feature_inner=fi,
+                real_feature=int(used_map[fi]),
+                threshold_bin=thr_bin,
+                threshold_double=mapper.bin_to_value(thr_bin),
+                left_value=float(r[REC_LEFT_OUT]),
+                right_value=float(r[REC_RIGHT_OUT]),
+                left_cnt=int(round(float(r[REC_LEFT_CNT]))),
+                right_cnt=int(round(float(r[REC_RIGHT_CNT]))),
+                gain=float(r[REC_GAIN]),
+                missing_type=int(self.np_missing[fi]),
+                default_left=bool(r[REC_DEFAULT_LEFT] > 0.5))
+            tree.internal_value[tree.num_leaves - 2] = float(r[REC_INTERNAL_VALUE])
+        return tree

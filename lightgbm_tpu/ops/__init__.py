@@ -1,0 +1,1 @@
+from . import histogram, split  # noqa: F401

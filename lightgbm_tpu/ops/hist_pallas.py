@@ -1,0 +1,102 @@
+"""Pallas TPU histogram kernel.
+
+The TPU replacement for the reference's OpenCL histogram kernels
+(`src/treelearner/ocl/histogram256.cl:343-360` and the 16/64 variants).  The
+OpenCL design builds per-workgroup sub-histograms in local memory with float
+atomics and then reduces; atomics do not exist on the TPU vector unit, so the
+kernel instead expands each row-block's bin codes into a one-hot matrix in
+VMEM and contracts it against the weight channels on the MXU:
+
+    out[f, c, b] += w[c, r_blk] @ (bins[f, r_blk] == b)
+
+Grid is (feature_tiles × row_blocks); the row-block axis is the sequential
+reduction dimension, accumulating into the same output block (the analogue of
+the OpenCL kernel's ``POWER_FEATURE_WORKGROUPS`` sub-histogram reduction).
+
+Layout notes:
+  * bins arrive (F, N) uint8 — feature-major so a block is (Ft, Rb) with rows
+    contiguous in lanes.
+  * weights arrive (3, N) f32: (grad·m, hess·m, m).
+  * out is (F, 3, B_pad) f32, transposed to the (F, B, 3) canonical layout by
+    the caller; B is padded to a lane multiple (128).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _round_up(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+def _hist_kernel(bins_ref, w_ref, out_ref, *, num_bins_padded: int,
+                 feature_tile: int):
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    w_blk = w_ref[...]  # (3, Rb) f32
+    rb = w_blk.shape[1]
+
+    def body(f, _):
+        row = bins_ref[f, :].astype(jnp.int32)  # (Rb,)
+        iota_b = jax.lax.broadcasted_iota(jnp.int32, (num_bins_padded, rb), 0)
+        onehot = (row[None, :] == iota_b).astype(jnp.float32)  # (B, Rb)
+        # HIGHEST precision: default MXU passes would round the f32 grads to
+        # bf16 (~1e-3 relative error per histogram sum — enough to change
+        # split choices); the one-hot operand is exact either way.
+        part = jax.lax.dot_general(
+            w_blk, onehot, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST)  # (3, B)
+        out_ref[f, :, :] += part
+        return 0
+
+    jax.lax.fori_loop(0, feature_tile, body, 0, unroll=True)
+
+
+@functools.partial(jax.jit, static_argnames=("num_bins", "feature_tile",
+                                             "row_block"))
+def build_histogram_pallas(bins: jax.Array, w: jax.Array, *, num_bins: int,
+                           feature_tile: int = 8, row_block: int = 2048
+                           ) -> jax.Array:
+    """hist[f,b,c] = Σ_r [bins[f,r]==b] · w[r,c] via a Pallas TPU kernel.
+
+    bins : (F, N) uint8/uint16, F a multiple of ``feature_tile`` (the dataset
+           pads features), N a multiple of ``row_block``.
+    w    : (N, 3) or (3, N) f32.
+    Returns (F, num_bins, 3) f32.
+    """
+    f, n = bins.shape
+    if w.ndim == 2 and w.shape[0] == n:
+        w = w.T
+    assert f % feature_tile == 0, (f, feature_tile)
+    rb = min(row_block, n)
+    while n % rb:  # rows are padded to a multiple of 1024 by the dataset
+        rb //= 2
+    assert rb >= 128, (n, row_block)
+    b_pad = _round_up(num_bins, 128)
+    grid = (f // feature_tile, n // rb)
+
+    out = pl.pallas_call(
+        functools.partial(_hist_kernel, num_bins_padded=b_pad,
+                          feature_tile=feature_tile),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((feature_tile, rb), lambda i, j: (i, j)),
+            pl.BlockSpec((3, rb), lambda i, j: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((feature_tile, 3, b_pad), lambda i, j: (i, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((f, 3, b_pad), jnp.float32),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+    )(bins, w)
+    return out[:, :, :num_bins].transpose(0, 2, 1)
