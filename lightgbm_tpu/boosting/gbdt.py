@@ -230,6 +230,16 @@ class GBDT:
         self._bag_mask = self._valid_rows
         self._bag_cnt = data.num_data
         self._np_bag_mask = np.asarray(base)
+        # parallel tree learning: shard over the local mesh so the jitted
+        # steps compile under GSPMD with ICI collectives
+        # (`tree_learner=data|feature|voting`, SURVEY §2.7)
+        self._mesh = None
+        self._parallel_mode = None
+        if self.cfg.tree_learner in ("data", "feature", "voting") \
+                and len(jax.devices()) > 1:
+            from ..parallel.learners import apply_parallel_sharding
+            from ..parallel.mesh import make_mesh
+            apply_parallel_sharding(self, make_mesh(), self.cfg.tree_learner)
 
     def add_valid_data(self, valid_data: Dataset, name: str,
                        metrics: Sequence[Metric]) -> None:
@@ -243,6 +253,14 @@ class GBDT:
 
     # -- bagging (`gbdt.cpp:180-241`, `ResetBaggingConfig` `gbdt.cpp:689`) ---
 
+    def _place_rows(self, arr: np.ndarray) -> jax.Array:
+        """Upload a row-aligned vector, sharded like the training rows."""
+        if self._mesh is not None and self._parallel_mode in ("data", "voting"):
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            return jax.device_put(arr, NamedSharding(
+                self._mesh, P(self._mesh.axis_names[0])))
+        return jnp.asarray(arr)
+
     def _bagging(self, iter_: int) -> None:
         cfg = self.cfg
         if cfg.bagging_freq > 0 and cfg.bagging_fraction < 1.0 \
@@ -252,7 +270,7 @@ class GBDT:
             idx = self._bag_rng.choice(n, bag_cnt, replace=False)
             mask = np.zeros(self.train_data.num_data_padded, dtype=np.float32)
             mask[idx] = 1.0
-            self._bag_mask = jnp.asarray(mask)
+            self._bag_mask = self._place_rows(mask)
             self._np_bag_mask = mask
             self._bag_cnt = bag_cnt
 

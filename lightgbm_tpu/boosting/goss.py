@@ -65,9 +65,9 @@ class GOSS(GBDT):
             mask[other_idx] = 1.0
             amp = np.ones(self.train_data.num_data_padded, dtype=np.float32)
             amp[other_idx] = multiply
-            self._bag_mask = jnp.asarray(mask)
+            self._bag_mask = self._place_rows(mask)
             self._np_bag_mask = mask
-            amp_d = jnp.asarray(amp)[None, :]
+            amp_d = self._place_rows(amp)[None, :]
             grad = grad * amp_d
             hess = hess * amp_d
         else:

@@ -111,6 +111,18 @@ class TPUTreeLearner:
         self.is_categorical = is_cat
         self.num_bins_padded = int(data.max_num_bin)
         self.num_features = data.num_used_features
+        # double-precision histogram accumulation — the reference's
+        # ``gpu_use_dp`` (`config.h:872-876`): training decisions then match
+        # the f64 CPU implementation exactly (needs jax_enable_x64)
+        self.hist_dp = bool(cfg.gpu_use_dp or cfg.tpu_double_precision)
+        if self.hist_dp:
+            import jax as _jax
+            if not _jax.config.jax_enable_x64:
+                import warnings
+                warnings.warn("gpu_use_dp/tpu_double_precision requested but "
+                              "jax_enable_x64 is off; falling back to f32 "
+                              "histogram accumulation")
+                self.hist_dp = False
         self.bins = data.device_bins()
         self._split_kwargs = dict(
             lambda_l1=float(cfg.lambda_l1), lambda_l2=float(cfg.lambda_l2),
@@ -128,7 +140,7 @@ class TPUTreeLearner:
 
     def _hist(self, w):
         h = build_histogram(self.bins, w, num_bins=self.num_bins_padded,
-                            backend=self.hist_backend)
+                            backend=self.hist_backend, dp=self.hist_dp)
         return h[:self.num_features]  # drop feature-tile padding rows
 
     def _leaf_cand(self, hist, sum_g, sum_h, cnt, feature_mask, depth_ok) -> _LeafCand:
@@ -146,9 +158,10 @@ class TPUTreeLearner:
         L = self.num_leaves
         w = jnp.stack([grad * bag, hess * bag, bag], axis=0)
         root_hist = self._hist(w)
-        sum_g = jnp.sum(grad * bag)
-        sum_h = jnp.sum(hess * bag)
-        cnt = jnp.sum(bag)
+        acc = jnp.float64 if self.hist_dp else jnp.float32
+        sum_g = jnp.sum((grad * bag).astype(acc))
+        sum_h = jnp.sum((hess * bag).astype(acc))
+        cnt = jnp.sum(bag.astype(acc))
         md = int(self.cfg.max_depth)
         depth_ok = jnp.asarray(True if md <= 0 else md > 0)
         root = self._leaf_cand(root_hist, sum_g, sum_h, cnt, feature_mask, depth_ok)
@@ -160,13 +173,13 @@ class TPUTreeLearner:
 
         cand_L = jax.tree_util.tree_map(expand, root)
         cand_L = cand_L._replace(gain=cand_L.gain.at[1:].set(-jnp.inf))
-        hist_pool = jnp.zeros((L, f, b, 3), jnp.float32).at[0].set(root_hist)
+        hist_pool = jnp.zeros((L, f, b, 3), root_hist.dtype).at[0].set(root_hist)
         return TreeState(
             leaf_id=jnp.zeros(n, jnp.int32),
             hist_pool=hist_pool,
-            leaf_sum_g=jnp.zeros(L, jnp.float32).at[0].set(sum_g),
-            leaf_sum_h=jnp.zeros(L, jnp.float32).at[0].set(sum_h),
-            leaf_cnt=jnp.zeros(L, jnp.float32).at[0].set(cnt),
+            leaf_sum_g=jnp.zeros(L, acc).at[0].set(sum_g),
+            leaf_sum_h=jnp.zeros(L, acc).at[0].set(sum_h),
+            leaf_cnt=jnp.zeros(L, acc).at[0].set(cnt),
             leaf_output=jnp.zeros(L, jnp.float32),
             leaf_depth=jnp.zeros(L, jnp.int32),
             cand=cand_L,

@@ -37,16 +37,21 @@ def _round_up(x: int, m: int) -> int:
     return ((x + m - 1) // m) * m
 
 
-@functools.partial(jax.jit, static_argnames=("num_bins", "row_block"))
+@functools.partial(jax.jit, static_argnames=("num_bins", "row_block", "dp"))
 def build_histogram_onehot(bins: jax.Array, w: jax.Array, *, num_bins: int,
-                           row_block: int = 4096) -> jax.Array:
+                           row_block: int = 4096, dp: bool = False
+                           ) -> jax.Array:
     """hist[f,b,c] = Σ_r [bins[f,r]==b] · w[c,r].
 
     Parameters
     ----------
     bins : (F, N) uint8/uint16 — bin codes (padded rows must carry w=0)
     w : (C, N) f32 — weight channels, typically (g·m, h·m, m)
-    Returns (F, num_bins, C) f32.
+    dp : accumulate in f64 and RETURN f64 — the analogue of the reference's
+         ``gpu_use_dp`` (`config.h:872-876`); the histogram pool and split
+         scans then run in f64 end-to-end so training decisions track the
+         f64 CPU reference (requires ``jax_enable_x64``).
+    Returns (F, num_bins, C) f32 (f64 when dp).
     """
     f, n = bins.shape
     if w.ndim == 2 and w.shape[1] != n:
@@ -57,37 +62,42 @@ def build_histogram_onehot(bins: jax.Array, w: jax.Array, *, num_bins: int,
         rb //= 2
     assert rb >= 1, (n, row_block)
     nblk = n // rb
+    acc_dtype = jnp.float64 if dp else jnp.float32
+    w = w.astype(acc_dtype)
     bins_r = bins.reshape(f, nblk, rb).transpose(1, 0, 2)  # (nblk, F, rb)
     w_r = w.reshape(c, nblk, rb).transpose(1, 2, 0)        # (nblk, rb, C)
 
     def body(acc, blk):
         b_blk, w_blk = blk                      # (F, rb) , (rb, C)
         oh = (b_blk[:, :, None] == jnp.arange(num_bins, dtype=jnp.int32)
-              [None, None, :].astype(bins.dtype)).astype(jnp.float32)
+              [None, None, :].astype(bins.dtype)).astype(acc_dtype)
         # contract rows on the MXU: (F, rb, B) × (rb, C) → (F, B, C).
         # HIGHEST precision is required: the default lets the MXU round the
         # f32 gradients to bf16, which costs ~1e-3 relative error in every
         # histogram sum and visibly degrades split gains.
         part = jax.lax.dot_general(
             oh, w_blk, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
+            preferred_element_type=acc_dtype,
             precision=jax.lax.Precision.HIGHEST)
         return acc + part, None
 
-    init = jnp.zeros((f, num_bins, c), dtype=jnp.float32)
+    init = jnp.zeros((f, num_bins, c), dtype=acc_dtype)
     hist, _ = jax.lax.scan(body, init, (bins_r, w_r))
     return hist
 
 
 def build_histogram(bins: jax.Array, w: jax.Array, *, num_bins: int,
-                    backend: str = "auto", row_block: int = 4096) -> jax.Array:
+                    backend: str = "auto", row_block: int = 4096,
+                    dp: bool = False) -> jax.Array:
     """Dispatch histogram construction to the best backend for this platform."""
     if backend == "auto":
         backend = "pallas" if bins.ndim == 2 and _on_tpu() else "onehot"
-    if backend == "pallas":
+    if backend == "pallas" and not dp:
         from .hist_pallas import build_histogram_pallas
         return build_histogram_pallas(bins, w, num_bins=num_bins)
-    return build_histogram_onehot(bins, w, num_bins=num_bins, row_block=row_block)
+    # dp falls back to the XLA path — f64 dots don't map onto the MXU
+    return build_histogram_onehot(bins, w, num_bins=num_bins,
+                                  row_block=row_block, dp=dp)
 
 
 def _on_tpu() -> bool:

@@ -1,0 +1,3 @@
+from .mesh import make_mesh, shard_dataset
+from .learners import (make_data_parallel, make_feature_parallel,
+                       apply_parallel_sharding)

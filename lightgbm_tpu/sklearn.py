@@ -212,9 +212,7 @@ class LGBMClassifier(LGBMModel):
                                     pred_contrib)
         if raw_score or pred_leaf or pred_contrib:
             return result
-        if self._n_classes > 2:
-            return self._classes[np.argmax(result, axis=1)]
-        return self._classes[(result > 0.5).astype(np.int64)]
+        return self._classes[np.argmax(result, axis=1)]
 
     def predict_proba(self, X, raw_score=False, num_iteration=-1,
                       pred_leaf=False, pred_contrib=False):

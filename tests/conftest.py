@@ -1,0 +1,30 @@
+"""Force an 8-virtual-device CPU platform for all tests.
+
+Mirrors the reference's CI strategy of exercising the full training paths on
+commodity hardware (`tests/python_package_test`); the virtual device mesh lets
+the distributed learners (`lightgbm_tpu/parallel`) run real XLA collectives
+on one host (the in-process fake the reference never had — SURVEY §4).
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+# the environment pins JAX_PLATFORMS=axon (remote TPU tunnel) and its
+# sitecustomize initializes the backend at interpreter start, so env vars are
+# too late — jax.config.update re-selects the platform reliably.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)  # for gpu_use_dp parity tests
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(42)

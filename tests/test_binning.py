@@ -1,0 +1,104 @@
+"""BinMapper unit tests — bin-boundary semantics are the root of numeric
+parity (reference `src/io/bin.cpp:72-420`)."""
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.binning import (BIN_CATEGORICAL, MISSING_NAN, MISSING_NONE,
+                                  MISSING_ZERO, BinMapper, greedy_find_bin)
+
+
+def _fit(values, total=None, max_bin=255, min_data_in_bin=3, min_split=20,
+         **kw):
+    m = BinMapper()
+    values = np.asarray(values, dtype=np.float64)
+    m.find_bin(values, total_sample_cnt=total or len(values), max_bin=max_bin,
+               min_data_in_bin=min_data_in_bin, min_split_data=min_split, **kw)
+    return m
+
+
+def test_distinct_values_fit_in_bins():
+    vals = np.repeat([1.0, 2.0, 3.0, 4.0], 25)
+    m = _fit(vals, min_data_in_bin=1, min_split=1)
+    assert m.num_bin >= 4
+    assert m.value_to_bin(1.0) != m.value_to_bin(2.0)
+    assert m.value_to_bin(3.9) == m.value_to_bin(4.0)
+    assert m.value_to_bin(3.4) == m.value_to_bin(3.0)
+    # upper bound of last bin is +inf
+    assert np.isinf(m.bin_upper_bound[-1])
+
+
+def test_zero_gets_own_bin():
+    # FindBinWithZeroAsOneBin: (-1e-35, 1e-35] is a dedicated bin
+    vals = np.concatenate([np.zeros(50), np.linspace(-5, 5, 50)])
+    m = _fit(vals, min_data_in_bin=1, min_split=1)
+    zb = m.value_to_bin(0.0)
+    assert m.value_to_bin(1e-40) == zb
+    assert m.value_to_bin(0.1) != zb
+    assert m.value_to_bin(-0.1) != zb
+    assert m.default_bin == zb
+
+
+def test_missing_nan_reserves_last_bin():
+    vals = np.array([0, 1, 2, 3, 4, 5, 6, 7, np.nan])
+    m = _fit(vals, min_data_in_bin=1, min_split=1)
+    assert m.missing_type == MISSING_NAN
+    assert m.value_to_bin(np.nan) == m.num_bin - 1
+    # non-nan values don't land in the nan bin
+    for v in range(8):
+        assert m.value_to_bin(v) < m.num_bin - 1
+
+
+def test_use_missing_false():
+    vals = np.array([0, 1, 2, np.nan])
+    m = _fit(vals, min_data_in_bin=1, min_split=1, use_missing=False)
+    assert m.missing_type == MISSING_NONE
+    # NaN folds to zero bin
+    assert m.value_to_bin(np.nan) == m.value_to_bin(0.0)
+
+
+def test_zero_as_missing():
+    vals = np.array([0, 0, 1, 2, 3, 4.0])
+    m = _fit(vals, min_data_in_bin=1, min_split=1, zero_as_missing=True)
+    assert m.missing_type == MISSING_ZERO
+
+
+def test_trivial_feature():
+    m = _fit(np.full(100, 3.14), min_split=20)
+    assert m.is_trivial
+
+
+def test_values_to_bins_vectorized_matches_scalar():
+    rng = np.random.RandomState(0)
+    vals = np.concatenate([rng.randn(500), [np.nan] * 10, np.zeros(30)])
+    m = _fit(vals, min_data_in_bin=1, min_split=1)
+    vec = m.values_to_bins(vals)
+    scalar = np.array([m.value_to_bin(v) for v in vals])
+    np.testing.assert_array_equal(vec, scalar)
+
+
+def test_categorical_count_sorted():
+    vals = np.concatenate([np.full(50, 2.0), np.full(30, 0.0), np.full(20, 7.0)])
+    m = _fit(vals, min_data_in_bin=1, min_split=1, bin_type=BIN_CATEGORICAL)
+    # most frequent category first, except bin 0 never holds category 0
+    assert m.bin_2_categorical[0] == 2
+    assert m.value_to_bin(2) == 0
+    assert m.value_to_bin(999) == m.num_bin - 1  # unseen -> last bin
+
+
+def test_greedy_find_bin_min_data():
+    dv = np.arange(10, dtype=np.float64)
+    ct = np.full(10, 5)
+    bounds = greedy_find_bin(dv, ct, max_bin=255, total_cnt=50,
+                             min_data_in_bin=10)
+    # every bin must hold >= 10 samples -> at most 5 bounds
+    assert len(bounds) <= 6
+
+
+def test_serialization_roundtrip():
+    vals = np.concatenate([np.random.RandomState(1).randn(200), [np.nan] * 5])
+    m = _fit(vals, min_data_in_bin=1, min_split=1)
+    m2 = BinMapper.from_dict(m.to_dict())
+    assert m2.num_bin == m.num_bin
+    np.testing.assert_array_equal(m2.bin_upper_bound, m.bin_upper_bound)
+    assert m2.value_to_bin(0.5) == m.value_to_bin(0.5)

@@ -1,0 +1,178 @@
+"""Device-op unit tests: histogram construction and the split finder against
+brute-force numpy references (the analogue of the reference's
+GPU_DEBUG_COMPARE histogram diff harness, `gpu_tree_learner.cpp:1019-1044`).
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from lightgbm_tpu.binning import MISSING_NAN, MISSING_NONE, MISSING_ZERO
+from lightgbm_tpu.ops.histogram import (build_histogram_onehot, fix_histogram,
+                                        subtract_sibling)
+from lightgbm_tpu.ops.split import find_best_splits
+
+
+def _np_hist(bins, w, num_bins):
+    f, n = bins.shape
+    out = np.zeros((f, num_bins, w.shape[0]))
+    for fi in range(f):
+        for c in range(w.shape[0]):
+            out[fi, :, c] = np.bincount(bins[fi], weights=w[c],
+                                        minlength=num_bins)
+    return out
+
+
+def test_histogram_matches_bincount(rng):
+    f, n, b = 5, 2048, 64
+    bins = rng.randint(0, b, size=(f, n)).astype(np.uint8)
+    w = rng.randn(3, n).astype(np.float32)
+    got = np.asarray(build_histogram_onehot(jnp.asarray(bins), jnp.asarray(w),
+                                            num_bins=b, row_block=512))
+    want = _np_hist(bins, w, b)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-4)
+
+
+def test_histogram_row_padding_not_multiple_of_block(rng):
+    f, n, b = 3, 1024 * 5, 16  # 5120 % 4096 != 0 regression
+    bins = rng.randint(0, b, size=(f, n)).astype(np.uint8)
+    w = rng.randn(3, n).astype(np.float32)
+    got = np.asarray(build_histogram_onehot(jnp.asarray(bins), jnp.asarray(w),
+                                            num_bins=b))
+    np.testing.assert_allclose(got, _np_hist(bins, w, b), rtol=1e-5, atol=1e-4)
+
+
+def test_subtraction_trick(rng):
+    f, n, b = 4, 512, 32
+    bins = rng.randint(0, b, size=(f, n)).astype(np.uint8)
+    w = np.abs(rng.randn(3, n)).astype(np.float32)
+    mask = (rng.rand(n) < 0.4).astype(np.float32)
+    full = build_histogram_onehot(jnp.asarray(bins), jnp.asarray(w),
+                                  num_bins=b, row_block=512)
+    child = build_histogram_onehot(jnp.asarray(bins),
+                                   jnp.asarray(w * mask[None, :]),
+                                   num_bins=b, row_block=512)
+    sibling = np.asarray(subtract_sibling(full, child))
+    want = _np_hist(bins, w * (1 - mask)[None, :], b)
+    np.testing.assert_allclose(sibling, want, rtol=1e-4, atol=1e-3)
+
+
+def _brute_force_best(hist, sum_g, sum_h, n, num_bin, missing, default_bin,
+                      min_data=1, min_hess=1e-3, l1=0.0, l2=0.0):
+    """Literal port of FindBestThresholdSequence for one feature."""
+    kEps = 1e-15
+    best = (-np.inf, -1, True)
+    sh = sum_h + 2 * kEps
+
+    def gain_term(g, h):
+        reg = max(0.0, abs(g) - l1)
+        out = -np.sign(g) * reg / (h + l2)
+        return -(2.0 * np.sign(g) * reg * out + (h + l2) * out * out)
+
+    two_scan = num_bin > 2 and missing != MISSING_NONE
+    # dir -1
+    use_na = two_scan and missing == MISSING_NAN
+    skip_def = two_scan and missing == MISSING_ZERO
+    rg, rh, rc = 0.0, kEps, 0.0
+    t = num_bin - 1 - (1 if use_na else 0)
+    while t >= 1:
+        if not (skip_def and t == default_bin):
+            rg += hist[t, 0]
+            rh += hist[t, 1]
+            rc += hist[t, 2]
+            if rc >= min_data and rh >= min_hess:
+                lc = n - rc
+                if lc < min_data:
+                    break
+                lh = sh - rh
+                if lh < min_hess:
+                    break
+                lg = sum_g - rg
+                g = gain_term(lg, lh) + gain_term(rg, rh)
+                if g > best[0]:
+                    best = (g, t - 1, True)
+        t -= 1
+    if two_scan:
+        lg, lh, lc = 0.0, kEps, 0.0
+        for t in range(0, num_bin - 1):
+            if skip_def and t == default_bin:
+                continue
+            if not (use_na and t >= num_bin - 1):
+                lg += hist[t, 0]
+                lh += hist[t, 1]
+                lc += hist[t, 2]
+            if lc < min_data or lh < min_hess:
+                continue
+            rc2 = n - lc
+            if rc2 < min_data:
+                break
+            rh2 = sh - lh
+            if rh2 < min_hess:
+                break
+            rg2 = sum_g - lg
+            g = gain_term(lg, lh) + gain_term(rg2, rh2)
+            if g > best[0]:
+                best = (g, t, False)
+    shift = gain_term(sum_g, sh)
+    return best[0] - shift, best[1], best[2]
+
+
+@pytest.mark.parametrize("missing", [MISSING_NONE, MISSING_ZERO, MISSING_NAN])
+def test_split_finder_vs_bruteforce(rng, missing):
+    f, b = 6, 24
+    hist = np.zeros((f, b, 3), dtype=np.float64)
+    num_bin = np.full(f, b, dtype=np.int32)
+    default_bin = rng.randint(1, b - 2, size=f).astype(np.int32)
+    for fi in range(f):
+        cnts = rng.randint(1, 50, size=b)
+        hist[fi, :, 2] = cnts
+        hist[fi, :, 0] = rng.randn(b) * cnts
+        hist[fi, :, 1] = cnts * 1.0
+    sum_g = hist[0].sum(0)[0] * 0 + hist[:, :, 0].sum()
+    # use per-feature totals consistent across features: same leaf totals
+    sum_g = hist[0, :, 0].sum()
+    sum_h = hist[0, :, 1].sum()
+    n = hist[0, :, 2].sum()
+    # make every feature's histogram sum to the same leaf totals
+    for fi in range(1, f):
+        hist[fi] *= 0
+        hist[fi] += hist[0]
+        perm = rng.permutation(b)
+        hist[fi] = hist[0][perm]
+
+    cands = find_best_splits(
+        jnp.asarray(hist, dtype=jnp.float32), jnp.asarray(sum_g, jnp.float32),
+        jnp.asarray(sum_h, jnp.float32), jnp.asarray(n, jnp.float32),
+        jnp.asarray(num_bin), jnp.asarray(np.full(f, missing, np.int32)),
+        jnp.asarray(default_bin), jnp.ones(f, dtype=bool),
+        min_data_in_leaf=1, min_sum_hessian_in_leaf=1e-3)
+
+    for fi in range(f):
+        want_gain, want_thr, want_left = _brute_force_best(
+            hist[fi], sum_g, sum_h, n, b, missing, default_bin[fi])
+        got_gain = float(cands.gain[fi])
+        if np.isinf(want_gain) or want_gain <= 0:
+            continue
+        assert abs(got_gain - want_gain) / max(abs(want_gain), 1) < 1e-4, fi
+        assert int(cands.threshold[fi]) == want_thr, (fi, missing)
+        assert bool(cands.default_left[fi]) == want_left, (fi, missing)
+
+
+def test_fix_histogram_reconstructs_default_bin(rng):
+    f, b = 3, 8
+    hist = np.abs(rng.randn(f, b, 3)).astype(np.float32)
+    default_bin = np.array([2, 0, 5], dtype=np.int32)
+    sum_g = hist[:, :, 0].sum(1) + 1.0   # true totals differ from hist sums
+    sum_h = hist[:, :, 1].sum(1) + 2.0
+    cnt = hist[:, :, 2].sum(1) + 3.0
+    fixed = np.asarray(fix_histogram(jnp.asarray(hist), jnp.asarray(default_bin),
+                                     jnp.asarray(sum_g), jnp.asarray(sum_h),
+                                     jnp.asarray(cnt)))
+    for fi in range(f):
+        d = default_bin[fi]
+        others = hist[fi, :, 0].sum() - hist[fi, d, 0]
+        assert abs(fixed[fi, d, 0] - (sum_g[fi] - others)) < 1e-4
+        # non-default bins untouched
+        mask = np.arange(b) != d
+        np.testing.assert_allclose(fixed[fi, mask], hist[fi, mask])

@@ -1,0 +1,73 @@
+"""Distributed tree learning on an 8-virtual-device CPU mesh.
+
+The reference has NO automated multi-node tests (SURVEY §4) — its only seam
+is the unused ``LGBM_NetworkInitWithFunctions`` hook.  Here the mesh is
+in-process, so the reference's implicit invariant — data-parallel training
+produces the same model as serial on the same data
+(`data_parallel_tree_learner.cpp` reduces exactly the same histograms) — is
+asserted directly.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.parallel.learners import apply_parallel_sharding
+from lightgbm_tpu.parallel.mesh import make_mesh
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 2, reason="needs a multi-device (virtual) mesh")
+
+
+def _problem(rng, n=2048, f=8):
+    X = rng.randn(n, f)
+    y = (X[:, 0] + 0.5 * X[:, 1] + 0.2 * rng.randn(n) > 0).astype(float)
+    return X, y
+
+
+def _train(X, y, mode, rounds=5):
+    params = {"objective": "binary", "num_leaves": 15, "min_data_in_leaf": 5,
+              "verbosity": -1, "tree_learner": mode}
+    ds = lgb.Dataset(X, label=y, params=params)
+    bst = lgb.Booster(params, ds)
+    if mode != "serial":
+        mesh = make_mesh()
+        apply_parallel_sharding(bst.gbdt, mesh, mode)
+    for _ in range(rounds):
+        bst.update()
+    return bst
+
+
+def test_data_parallel_equals_serial(rng):
+    X, y = _problem(rng)
+    serial = _train(X, y, "serial")
+    dp = _train(X, y, "data")
+    ps, pd = serial.predict(X), dp.predict(X)
+    # f32 all-reduce ordering can flip near-tie splits, so assert model
+    # equivalence at prediction level rather than structural identity
+    np.testing.assert_allclose(ps, pd, rtol=1e-3, atol=1e-3)
+
+
+def test_feature_parallel_equals_serial(rng):
+    X, y = _problem(rng)
+    serial = _train(X, y, "serial")
+    fp = _train(X, y, "feature")
+    np.testing.assert_allclose(serial.predict(X), fp.predict(X),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_data_parallel_with_bagging(rng):
+    X, y = _problem(rng)
+    params = {"objective": "binary", "num_leaves": 15, "min_data_in_leaf": 5,
+              "verbosity": -1, "tree_learner": "data",
+              "bagging_fraction": 0.8, "bagging_freq": 1,
+              "metric": "binary_logloss"}
+    ds = lgb.Dataset(X, label=y, params=params)
+    bst = lgb.Booster(params, ds)
+    apply_parallel_sharding(bst.gbdt, make_mesh(), "data")
+    for _ in range(5):
+        bst.update()
+    pred = bst.predict(X)
+    assert ((pred > 0.5) == y).mean() > 0.8

@@ -1,0 +1,68 @@
+"""sklearn wrapper tests (`tests/python_package_test/test_sklearn.py`)."""
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu import LGBMClassifier, LGBMRanker, LGBMRegressor
+
+
+def test_regressor(rng):
+    X = rng.randn(300, 5)
+    y = X[:, 0] * 2 + 0.1 * rng.randn(300)
+    m = LGBMRegressor(n_estimators=20, num_leaves=15, min_child_samples=5)
+    m.fit(X, y)
+    pred = m.predict(X)
+    assert np.mean((pred - y) ** 2) < 0.5
+    assert m.feature_importances_.sum() > 0
+    assert m.n_features_ == 5
+
+
+def test_classifier_binary(rng):
+    X = rng.randn(300, 5)
+    y = np.where(X[:, 0] > 0, "pos", "neg")
+    m = LGBMClassifier(n_estimators=20, num_leaves=15, min_child_samples=5)
+    m.fit(X, y)
+    assert set(m.classes_) == {"neg", "pos"}
+    pred = m.predict(X)
+    assert (pred == y).mean() > 0.9
+    proba = m.predict_proba(X)
+    assert proba.shape == (300, 2)
+    np.testing.assert_allclose(proba.sum(axis=1), 1.0, rtol=1e-6)
+
+
+def test_classifier_multiclass(rng):
+    X = rng.randn(400, 5)
+    y = np.argmax(X[:, :3], axis=1)
+    m = LGBMClassifier(n_estimators=20, num_leaves=15, min_child_samples=5)
+    m.fit(X, y)
+    assert m.n_classes_ == 3
+    assert (m.predict(X) == y).mean() > 0.85
+
+
+def test_ranker(rng):
+    nq, per = 20, 10
+    X = rng.randn(nq * per, 4)
+    y = np.digitize(X[:, 0], [-0.5, 0.5]).astype(float)
+    m = LGBMRanker(n_estimators=10, num_leaves=7, min_child_samples=2)
+    m.fit(X, y, group=np.full(nq, per))
+    scores = m.predict(X)
+    assert np.corrcoef(scores, X[:, 0])[0, 1] > 0.5
+
+
+def test_eval_set_and_early_stopping(rng):
+    X = rng.randn(400, 5)
+    y = (X[:, 0] > 0).astype(int)
+    m = LGBMClassifier(n_estimators=100, num_leaves=7, min_child_samples=5)
+    m.fit(X[:300], y[:300], eval_set=[(X[300:], y[300:])],
+          eval_metric=["binary_logloss"], early_stopping_rounds=5,
+          verbose=False)
+    assert m.best_iteration_ > 0
+    assert len(m.evals_result_["valid_0"]["binary_logloss"]) <= 100
+
+
+def test_get_set_params():
+    m = LGBMRegressor(num_leaves=7, custom_thing=3)
+    p = m.get_params()
+    assert p["num_leaves"] == 7 and p["custom_thing"] == 3
+    m.set_params(num_leaves=15)
+    assert m.num_leaves == 15
