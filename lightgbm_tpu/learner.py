@@ -135,6 +135,7 @@ class TPUTreeLearner:
         self._cat_mask = jnp.asarray(~is_cat)
         self._jit_init = jax.jit(self._init_root)
         self._jit_step = jax.jit(self._split_step, donate_argnums=(0,))
+        self._jit_tree = jax.jit(self._train_tree_fused)
 
     # -- device functions ----------------------------------------------------
 
@@ -287,20 +288,35 @@ class TPUTreeLearner:
             num_leaves=state.num_leaves + do.astype(jnp.int32),
             records=records)
 
+    def _train_tree_fused(self, grad, hess, bag, feature_mask) -> TreeState:
+        """The whole leaf-wise growth loop as ONE XLA computation — the
+        fusion the reference can't have (its loop is host control flow,
+        `serial_tree_learner.cpp:185-218`); on TPU it removes per-split
+        dispatch latency entirely."""
+        state = self._init_root(grad, hess, bag, feature_mask)
+
+        def body(i, st):
+            return self._split_step(st, grad, hess, bag, feature_mask, i)
+
+        return jax.lax.fori_loop(0, self.num_leaves - 1, body, state)
+
     # -- host orchestration --------------------------------------------------
 
     def train(self, grad: jax.Array, hess: jax.Array, bag: jax.Array,
-              feature_mask: Optional[jax.Array] = None
+              feature_mask: Optional[jax.Array] = None, fused: bool = True
               ) -> Tuple[Tree, jax.Array]:
         """Build one tree; returns (host Tree with unit shrinkage, device
         leaf_id for the score updater)."""
         f = self.num_features
         if feature_mask is None:
             feature_mask = jnp.ones(f, dtype=bool)
-        state = self._jit_init(grad, hess, bag, feature_mask)
-        for i in range(self.num_leaves - 1):
-            state = self._jit_step(state, grad, hess, bag, feature_mask,
-                                   jnp.asarray(i, jnp.int32))
+        if fused:
+            state = self._jit_tree(grad, hess, bag, feature_mask)
+        else:
+            state = self._jit_init(grad, hess, bag, feature_mask)
+            for i in range(self.num_leaves - 1):
+                state = self._jit_step(state, grad, hess, bag, feature_mask,
+                                       jnp.asarray(i, jnp.int32))
         records = np.asarray(state.records)  # single host sync per tree
         tree = self._assemble(records)
         return tree, state.leaf_id
