@@ -102,6 +102,10 @@ def rebind_tree_to_dataset(tree: Tree, data: _ConstructedDataset) -> None:
         if not (tree.decision_type[nd] & 1):  # numerical
             tree.threshold_in_bin[nd] = data.bin_mappers[inner].value_to_bin(
                 float(tree.threshold[nd]))
+    # the cached traversal pack (if any) was built from the previous bin
+    # space — the bin-space transition owns its invalidation
+    if hasattr(tree, "_traverse_pack"):
+        del tree._traverse_pack
     tree.needs_rebind = False
 
 
@@ -115,7 +119,7 @@ def _traverse_tree_binned(data: _ConstructedDataset, tree: Tree) -> jax.Array:
     """
     ni = tree.num_leaves - 1
     pack = getattr(tree, "_traverse_pack", None)
-    if pack is None or pack[0] != tree.num_leaves:
+    if pack is None or pack[0] != tree.num_leaves or pack[-1] is not data:
         num_bin, missing, default_bin, _ = data.feature_meta_arrays()
         feat = tree.split_feature_inner[:ni]
         depth = int(tree.leaf_depth[:tree.num_leaves].max())
@@ -125,10 +129,11 @@ def _traverse_tree_binned(data: _ConstructedDataset, tree: Tree) -> jax.Array:
                 jnp.asarray(num_bin[feat] - 1),
                 jnp.asarray((tree.decision_type[:ni] & 2) != 0),
                 jnp.asarray(tree.left_child[:ni]),
-                jnp.asarray(tree.right_child[:ni]))
+                jnp.asarray(tree.right_child[:ni]),
+                data)  # bin-space owner, part of the cache key
         tree._traverse_pack = pack
     _, depth, feat, thr, node_missing, node_default_bin, node_nan_bin, \
-        node_default_left, left_child, right_child = pack
+        node_default_left, left_child, right_child, _ = pack
     # leaf values change under DART re-shrinkage, so always ship them fresh
     leaf_value = jnp.asarray(tree.leaf_value[:tree.num_leaves]
                              .astype(np.float32))

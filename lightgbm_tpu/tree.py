@@ -243,12 +243,12 @@ class Tree:
         def ints(key, n):
             if n == 0 or key not in kv or not kv[key]:
                 return np.zeros(n, dtype=np.int32)
-            return np.fromstring(kv[key], dtype=np.float64, sep=" ").astype(np.int32)[:n]
+            return np.array(kv[key].split(), dtype=np.float64).astype(np.int32)[:n]
 
         def floats(key, n):
             if n == 0 or key not in kv or not kv[key]:
                 return np.zeros(n, dtype=np.float64)
-            return np.fromstring(kv[key], dtype=np.float64, sep=" ")[:n]
+            return np.array(kv[key].split(), dtype=np.float64)[:n]
 
         if ni > 0:
             t.split_feature[:ni] = ints("split_feature", ni)
@@ -265,7 +265,32 @@ class Tree:
             t.cat_boundaries = [int(x) for x in kv["cat_boundaries"].split()]
             t.cat_threshold = [int(x) for x in kv["cat_threshold"].split()]
         t.shrinkage = float(kv.get("shrinkage", 1))
+        # leaf_depth/leaf_parent are not part of the model text format
+        # (matching `src/io/tree.cpp:207-240`), but the device traversal
+        # sizes its scan by leaf_depth.max() — reconstruct both by walking
+        # the child arrays from the root.
+        t._rebuild_depths()
         return t
+
+    def _rebuild_depths(self) -> None:
+        if self.num_leaves <= 1:
+            self.leaf_depth[:1] = 0
+            return
+        visited = set()
+        stack = [(0, 0)]  # (node, depth)
+        while stack:
+            node, depth = stack.pop()
+            if node in visited or node >= self.num_leaves - 1:
+                raise ValueError("malformed tree: child arrays do not form a "
+                                 "binary tree")
+            visited.add(node)
+            for child in (self.left_child[node], self.right_child[node]):
+                if child < 0:
+                    leaf = ~child
+                    self.leaf_depth[leaf] = depth + 1
+                    self.leaf_parent[leaf] = node
+                else:
+                    stack.append((int(child), depth + 1))
 
     # -- packed arrays for the device batch predictor ------------------------
 
