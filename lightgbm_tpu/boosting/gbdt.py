@@ -117,9 +117,11 @@ def _traverse_tree_binned(data: _ConstructedDataset, tree: Tree) -> jax.Array:
     mappers, so they are built once per tree and cached on it — train and
     valid sets reuse the same pack.
     """
+    import weakref
+
     ni = tree.num_leaves - 1
     pack = getattr(tree, "_traverse_pack", None)
-    if pack is None or pack[0] != tree.num_leaves or pack[-1] is not data:
+    if pack is None or pack[0] != tree.num_leaves or pack[-1]() is not data:
         num_bin, missing, default_bin, _ = data.feature_meta_arrays()
         feat = tree.split_feature_inner[:ni]
         depth = int(tree.leaf_depth[:tree.num_leaves].max())
@@ -130,7 +132,7 @@ def _traverse_tree_binned(data: _ConstructedDataset, tree: Tree) -> jax.Array:
                 jnp.asarray((tree.decision_type[:ni] & 2) != 0),
                 jnp.asarray(tree.left_child[:ni]),
                 jnp.asarray(tree.right_child[:ni]),
-                data)  # bin-space owner, part of the cache key
+                weakref.ref(data))  # bin-space owner, part of the cache key
         tree._traverse_pack = pack
     _, depth, feat, thr, node_missing, node_default_bin, node_nan_bin, \
         node_default_left, left_child, right_child, _ = pack
@@ -218,7 +220,8 @@ class GBDT:
             else max(self.cfg.num_class, 1))
         if objective is not None:
             objective.init(data.metadata, data.num_data, data.num_data_padded)
-        self.learner = TPUTreeLearner(self.cfg, data)
+        from ..learner_compact import create_tree_learner
+        self.learner = create_tree_learner(self.cfg, data)
         self.train_score = ScoreUpdater(data, self.num_tree_per_iteration)
         self.training_metrics = list(training_metrics)
         self.max_feature_idx = data.num_total_features - 1

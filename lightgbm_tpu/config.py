@@ -312,6 +312,12 @@ class Config:
     tpu_row_block: int = 1024
     tpu_hist_dtype: str = "float32"
     tpu_double_precision: bool = False  # use f64 split accounting (CPU testing)
+    # tree-build strategy: "compact" keeps rows permuted so each leaf's rows
+    # are contiguous (O(N log L) row-visits/tree); "masked" builds every
+    # histogram with a full-data masked pass (O(N L), kept as the reference
+    # implementation / fallback); "auto" = compact
+    tpu_learner: str = "auto"
+    tpu_min_window: int = 2048  # smallest compacted histogram window
 
     # derived (not user-settable)
     is_parallel: bool = field(default=False, repr=False)

@@ -288,12 +288,10 @@ def _continue_training(booster: Booster, init_booster: Booster) -> None:
     gbdt.num_tree_per_iteration = src.num_tree_per_iteration
     gbdt.iter_ = len(gbdt.models) // max(gbdt.num_tree_per_iteration, 1)
     for tree in gbdt.models:
-        # the copied inner fields (split_feature_inner / threshold_in_bin and
-        # any cached _traverse_pack) are in the SOURCE dataset's bin space —
-        # always rebind against the new training data's bins
+        # the copied inner fields (split_feature_inner / threshold_in_bin)
+        # are in the SOURCE dataset's bin space — always rebind against the
+        # new training data's bins (rebind also drops the traversal cache)
         tree.needs_rebind = True
-        if hasattr(tree, "_traverse_pack"):
-            del tree._traverse_pack
         rebind_tree_to_dataset(tree, gbdt.train_data)
     for idx, tree in enumerate(gbdt.models):
         k = idx % gbdt.num_tree_per_iteration

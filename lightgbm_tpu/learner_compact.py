@@ -1,0 +1,444 @@
+"""Compacted TPU tree learner: leaf-wise growth over leaf-contiguous rows.
+
+This is the O(N log L) redesign of the masked learner in ``learner.py``
+(which pays a full-data histogram pass per split — O(N·L) row-visits per
+tree).  It is the TPU-native analogue of the reference's ``DataPartition``
+(`src/treelearner/data_partition.hpp`): the reference keeps a permuted row
+index array so each leaf's rows are contiguous and builds the smaller
+child's histogram over just those rows
+(`serial_tree_learner.cpp:371-385`); here the PAYLOADS themselves (packed
+bin codes, gradient channels, row ids) are kept permuted — TPUs have no
+fast random gather, so instead of indices we move the data with a stable
+one-bit-key `lax.sort` over the parent's window at every split:
+
+  * rows of leaf ℓ live at positions ``[leaf_start[ℓ], leaf_start[ℓ]+cnt)``
+  * a split sorts only that window (keys: before/left/right/after, stable)
+  * the smaller child's histogram runs over a power-of-two bucketed
+    ``dynamic_slice`` window (``lax.switch`` picks the bucket) through the
+    packed-word Pallas kernel; the sibling comes from parent subtraction
+    (`feature_histogram.hpp:67`).
+
+Σ window sizes over a tree ≈ Σ min(|left|,|right|) ≈ N·log₂(num_leaves),
+the reference CPU budget.  Split semantics (gain math, missing handling,
+tie-breaks, min_data/min_hessian limits) are byte-identical to the masked
+learner — both call ``ops.split.find_best_splits``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from .binning import MISSING_NAN, MISSING_ZERO
+from .config import Config
+from .dataset import _ConstructedDataset
+from .learner import (NUM_REC_FIELDS, REC_DEFAULT_LEFT, REC_FEATURE, REC_GAIN,
+                      REC_INTERNAL_CNT, REC_INTERNAL_VALUE, REC_LEAF,
+                      REC_LEFT_CNT, REC_LEFT_OUT, REC_LEFT_SUM_G,
+                      REC_LEFT_SUM_H, REC_RIGHT_CNT, REC_RIGHT_OUT,
+                      REC_RIGHT_SUM_G, REC_RIGHT_SUM_H, REC_THRESHOLD,
+                      REC_VALID, TPUTreeLearner, _LeafCand)
+from .ops.hist_pallas import (build_histogram_packed, pack_bin_words,
+                              unpack_bin_words)
+from .ops.histogram import _on_tpu, build_histogram_onehot
+from .ops.split import SplitCandidates, find_best_splits
+from .tree import Tree
+
+
+class CompactState(NamedTuple):
+    bins_p: jax.Array      # (Fw, N) int32 — packed bins, permuted by leaf
+    w_p: jax.Array         # (3, N) f32 — (g·bag, h·bag, bag), permuted
+    rid_p: jax.Array       # (N,) int32 — original row id at each position
+    lid_p: jax.Array       # (N,) int32 — leaf id at each position
+    leaf_start: jax.Array  # (L,) int32 — window start per leaf
+    leaf_wcnt: jax.Array   # (L,) int32 — window size (incl. out-of-bag/pad)
+    hist_pool: jax.Array   # (L, F, B, 3)
+    leaf_sum_g: jax.Array  # (L,)
+    leaf_sum_h: jax.Array
+    leaf_cnt: jax.Array    # (L,) bagged counts (histogram dtype)
+    leaf_output: jax.Array
+    leaf_depth: jax.Array
+    cand: _LeafCand        # per-leaf best splits, fields (L,)
+    num_leaves: jax.Array
+    rec_f: jax.Array       # (L-1, NUM_REC_FIELDS) f32
+    rec_i: jax.Array       # (L-1, 2) int32 — exact bagged left/right counts
+
+
+class CompactTPUTreeLearner(TPUTreeLearner):
+    """Leaf-wise learner with leaf-contiguous row compaction (see module
+    docstring).  Factory slot: `src/treelearner/tree_learner.cpp:9-33`,
+    (tree_learner=serial, device_type=tpu)."""
+
+    def __init__(self, cfg: Config, data: _ConstructedDataset,
+                 hist_backend: str = "auto"):
+        super().__init__(cfg, data, hist_backend)
+        self.n_pad = int(data.num_data_padded)
+        f_pad = data.bins.shape[0]           # padded to a multiple of 8
+        assert f_pad % 4 == 0, f_pad
+        self.fw = f_pad // 4
+        self._bins_packed = None             # packed device array, lazy
+        # power-of-two window buckets, smallest..largest(=N); the Pallas
+        # kernel requires window sizes that are multiples of 1024
+        mw = max(int(cfg.tpu_min_window), 1024)
+        mw = 1 << (mw - 1).bit_length()  # round up to a power of two
+        sizes = []
+        s0 = mw
+        while s0 < self.n_pad:
+            sizes.append(s0)
+            s0 *= 2
+        sizes.append(self.n_pad)
+        self._win_sizes = sizes
+        self._win_sizes_arr = jnp.asarray(sizes, dtype=jnp.int32)
+        self._use_pallas = (hist_backend in ("auto", "pallas")
+                            and _on_tpu() and not self.hist_dp
+                            and self.n_pad % 1024 == 0)
+        self._jit_tree_c = jax.jit(self._train_tree_compact)
+
+    # -- packed bins ---------------------------------------------------------
+
+    def bins_packed(self) -> jax.Array:
+        if self._bins_packed is None:
+            self._bins_packed = pack_bin_words(self.data.device_bins())
+        return self._bins_packed
+
+    # -- bucket helpers ------------------------------------------------------
+
+    def _bucket_idx(self, cnt):
+        """Index of the smallest window size >= cnt."""
+        return jnp.sum(cnt > self._win_sizes_arr).astype(jnp.int32)
+
+    # -- windowed histogram --------------------------------------------------
+
+    def _make_hist_branch(self, S: int):
+        fw, f, b = self.fw, self.num_features, self.num_bins_padded
+        n = self.n_pad
+
+        def branch(bins_p, w_p, start, cnt):
+            sa = jnp.clip(start, 0, n - S).astype(jnp.int32)
+            off = (start - sa).astype(jnp.int32)
+            bw = lax.dynamic_slice(bins_p, (jnp.int32(0), sa), (fw, S))
+            ww = lax.dynamic_slice(w_p, (jnp.int32(0), sa), (3, S))
+            pos = jnp.arange(S, dtype=jnp.int32)
+            m = ((pos >= off) & (pos < off + cnt))
+            wm = ww * m[None, :].astype(ww.dtype)
+            if self._use_pallas:
+                h = build_histogram_packed(bw, wm, num_bins=b)[:f]
+            else:
+                bu = unpack_bin_words(bw, f)
+                h = build_histogram_onehot(bu, wm, num_bins=b, dp=self.hist_dp)
+            return h
+
+        return branch
+
+    # -- windowed stable partition ------------------------------------------
+
+    def _make_partition_branch(self, S: int):
+        fw, n = self.fw, self.n_pad
+
+        def branch(bins_p, w_p, rid_p, lid_p, s, c, feat, thr, dleft,
+                   new_leaf, do):
+            sa = jnp.clip(s, 0, n - S).astype(jnp.int32)
+            off = (s - sa).astype(jnp.int32)
+            bw = lax.dynamic_slice(bins_p, (jnp.int32(0), sa), (fw, S))
+            ww = lax.dynamic_slice(w_p, (jnp.int32(0), sa), (3, S))
+            rid = lax.dynamic_slice(rid_p, (sa,), (S,))
+            lid = lax.dynamic_slice(lid_p, (sa,), (S,))
+            pos = jnp.arange(S, dtype=jnp.int32)
+            in_seg = (pos >= off) & (pos < off + c)
+            # decision on the split feature (NumericalDecisionInner,
+            # `tree.h:233-249`) — unpack the one feature from its word
+            word = lax.dynamic_slice(bw, (feat // 4, jnp.int32(0)), (1, S))[0]
+            frow = (word >> ((feat % 4) * 8)) & 0xFF
+            mt = self.f_missing[feat]
+            db = self.f_default_bin[feat]
+            nb = self.f_num_bin[feat]
+            is_missing = ((mt == MISSING_ZERO) & (frow == db)) | \
+                         ((mt == MISSING_NAN) & (frow == nb - 1))
+            go_left = jnp.where(is_missing, dleft, frow <= thr)
+            key = jnp.where(in_seg,
+                            jnp.where(go_left, 1, 2),
+                            jnp.where(pos < off, 0, 3)).astype(jnp.int32)
+            key = jnp.where(do, key, 0)
+            ops = ([key] + [bw[i] for i in range(fw)]
+                   + [ww[0], ww[1], ww[2], rid, lid])
+            sd = lax.sort(ops, num_keys=1, is_stable=True)
+            bw2 = jnp.stack(sd[1:1 + fw])
+            ww2 = jnp.stack(sd[1 + fw:4 + fw])
+            rid2, lid2 = sd[4 + fw], sd[5 + fw]
+            segl = in_seg & go_left
+            lc_w = jnp.sum(segl.astype(jnp.int32))
+            bag = ww[2] > 0.5
+            lc_bag = jnp.sum((segl & bag).astype(jnp.int32))
+            c_bag = jnp.sum((in_seg & bag).astype(jnp.int32))
+            in_right = (pos >= off + lc_w) & (pos < off + c)
+            lid2 = jnp.where(do & in_right, new_leaf, lid2)
+            bins_p = lax.dynamic_update_slice(bins_p, bw2, (jnp.int32(0), sa))
+            w_p = lax.dynamic_update_slice(w_p, ww2, (jnp.int32(0), sa))
+            rid_p = lax.dynamic_update_slice(rid_p, rid2, (sa,))
+            lid_p = lax.dynamic_update_slice(lid_p, lid2, (sa,))
+            return bins_p, w_p, rid_p, lid_p, lc_w, lc_bag, c_bag
+
+        return branch
+
+    # -- per-leaf candidates -------------------------------------------------
+
+    def _leaf_cands_pair(self, hist_l, hist_r, info, feature_mask,
+                         depth_ok) -> Tuple[_LeafCand, _LeafCand]:
+        """Best splits for both children in one batched scan."""
+        hist2 = jnp.stack([hist_l, hist_r])
+        sg = jnp.stack([info.left_sum_g, info.right_sum_g])
+        sh = jnp.stack([info.left_sum_h, info.right_sum_h])
+        cn = jnp.stack([info.left_cnt, info.right_cnt])
+        fmask = feature_mask & self._cat_mask
+
+        cands = jax.vmap(
+            lambda h, g, hh, c: find_best_splits(
+                h, g, hh, c, self.f_num_bin, self.f_missing,
+                self.f_default_bin, fmask, **self._split_kwargs)
+        )(hist2, sg, sh, cn)
+
+        best_f = jnp.argmax(cands.gain, axis=1).astype(jnp.int32)  # (2,)
+        pick = lambda a: jnp.take_along_axis(a, best_f[:, None], axis=1)[:, 0]
+        out = []
+        for i in range(2):
+            lc = _LeafCand(
+                gain=jnp.where(depth_ok, cands.gain[i, best_f[i]], -jnp.inf),
+                feature=best_f[i],
+                threshold=pick(cands.threshold)[i],
+                default_left=pick(cands.default_left)[i],
+                left_sum_g=pick(cands.left_sum_g)[i],
+                left_sum_h=pick(cands.left_sum_h)[i],
+                left_cnt=pick(cands.left_cnt)[i],
+                right_sum_g=pick(cands.right_sum_g)[i],
+                right_sum_h=pick(cands.right_sum_h)[i],
+                right_cnt=pick(cands.right_cnt)[i],
+                left_output=pick(cands.left_output)[i],
+                right_output=pick(cands.right_output)[i])
+            out.append(lc)
+        return out[0], out[1]
+
+    # -- root ----------------------------------------------------------------
+
+    def _init_root_compact(self, grad, hess, bag, feature_mask) -> CompactState:
+        n, f, b, L = self.n_pad, self.num_features, self.num_bins_padded, \
+            self.num_leaves
+        w = jnp.stack([grad * bag, hess * bag, bag], axis=0)
+        bins_p = self.bins_packed()
+        root_hist = self._hist_branches[-1](bins_p, w, jnp.int32(0),
+                                            jnp.int32(n))
+        acc = jnp.float64 if self.hist_dp else jnp.float32
+        sum_g = jnp.sum((grad * bag).astype(acc))
+        sum_h = jnp.sum((hess * bag).astype(acc))
+        cnt = jnp.sum(bag.astype(acc))
+        md = int(self.cfg.max_depth)
+        depth_ok = jnp.asarray(True if md <= 0 else md > 0)
+        root = self._leaf_cand(root_hist, sum_g, sum_h, cnt, feature_mask,
+                               depth_ok)
+
+        def expand(x):
+            x = jnp.asarray(x)
+            return jnp.concatenate(
+                [x[None], jnp.zeros((L - 1,) + x.shape, x.dtype)], axis=0)
+
+        cand_L = jax.tree_util.tree_map(expand, root)
+        cand_L = cand_L._replace(gain=cand_L.gain.at[1:].set(-jnp.inf))
+        return CompactState(
+            bins_p=bins_p,
+            w_p=w,
+            rid_p=jnp.arange(n, dtype=jnp.int32),
+            lid_p=jnp.zeros(n, jnp.int32),
+            leaf_start=jnp.zeros(L, jnp.int32),
+            leaf_wcnt=jnp.zeros(L, jnp.int32).at[0].set(n),
+            hist_pool=jnp.zeros((L, f, b, 3), root_hist.dtype).at[0]
+                         .set(root_hist),
+            leaf_sum_g=jnp.zeros(L, acc).at[0].set(sum_g),
+            leaf_sum_h=jnp.zeros(L, acc).at[0].set(sum_h),
+            leaf_cnt=jnp.zeros(L, acc).at[0].set(cnt),
+            leaf_output=jnp.zeros(L, jnp.float32),
+            leaf_depth=jnp.zeros(L, jnp.int32),
+            cand=cand_L,
+            num_leaves=jnp.asarray(1, jnp.int32),
+            rec_f=jnp.zeros((L - 1, NUM_REC_FIELDS), jnp.float32),
+            rec_i=jnp.zeros((L - 1, 2), jnp.int32))
+
+    # -- one split -----------------------------------------------------------
+
+    def _split_step_compact(self, state: CompactState, feature_mask,
+                            step_idx) -> CompactState:
+        cfg = self.cfg
+        cand = state.cand
+        best_leaf = jnp.argmax(cand.gain).astype(jnp.int32)
+        best_gain = cand.gain[best_leaf]
+        do = best_gain > 0.0
+        info = jax.tree_util.tree_map(lambda a: a[best_leaf], cand)
+        new_leaf = state.num_leaves
+        s = state.leaf_start[best_leaf]
+        c = state.leaf_wcnt[best_leaf]
+
+        # ---- partition the parent's window (DataPartition::Split)
+        pidx = self._bucket_idx(c)
+        bins_p, w_p, rid_p, lid_p, lc_w, lc_bag, c_bag = lax.switch(
+            pidx, self._partition_branches, state.bins_p, state.w_p,
+            state.rid_p, state.lid_p, s, c, info.feature, info.threshold,
+            info.default_left, new_leaf, do)
+        rc_w = c - lc_w
+
+        # ---- smaller-child histogram + sibling subtraction
+        # (`serial_tree_learner.cpp:371-385`)
+        left_smaller = lc_w <= rc_w
+        small_start = jnp.where(left_smaller, s, s + lc_w)
+        small_cnt = jnp.minimum(lc_w, rc_w)
+        hidx = self._bucket_idx(jnp.maximum(small_cnt, 1))
+        hist_small = lax.switch(hidx, self._hist_branches, bins_p, w_p,
+                                small_start, small_cnt)
+        hist_parent = state.hist_pool[best_leaf]
+        hist_large = hist_parent - hist_small
+        hist_left = jnp.where(left_smaller, hist_small, hist_large)
+        hist_right = jnp.where(left_smaller, hist_large, hist_small)
+        hist_pool = state.hist_pool
+        hist_pool = hist_pool.at[best_leaf].set(
+            jnp.where(do, hist_left, hist_parent))
+        hist_pool = hist_pool.at[new_leaf].set(
+            jnp.where(do, hist_right, hist_pool[new_leaf]))
+
+        # ---- leaf bookkeeping
+        upd = lambda arr, l_val, r_val: (
+            arr.at[best_leaf].set(jnp.where(do, l_val, arr[best_leaf]))
+               .at[new_leaf].set(jnp.where(do, r_val, arr[new_leaf])))
+        leaf_sum_g = upd(state.leaf_sum_g, info.left_sum_g, info.right_sum_g)
+        leaf_sum_h = upd(state.leaf_sum_h, info.left_sum_h, info.right_sum_h)
+        leaf_cnt = upd(state.leaf_cnt, info.left_cnt, info.right_cnt)
+        prev_output = state.leaf_output[best_leaf]
+        leaf_output = upd(state.leaf_output, info.left_output,
+                          info.right_output)
+        child_depth = state.leaf_depth[best_leaf] + 1
+        leaf_depth = upd(state.leaf_depth, child_depth, child_depth)
+        leaf_start = state.leaf_start.at[new_leaf].set(
+            jnp.where(do, s + lc_w, state.leaf_start[new_leaf]))
+        leaf_wcnt = upd(state.leaf_wcnt, lc_w, rc_w)
+
+        # ---- children's best splits
+        md = int(cfg.max_depth)
+        depth_ok = jnp.asarray(True) if md <= 0 else (child_depth < md)
+        cand_left, cand_right = self._leaf_cands_pair(
+            hist_left, hist_right, info, feature_mask, depth_ok)
+
+        def upd_cand(arr, l_val, r_val):
+            return (arr.at[best_leaf].set(jnp.where(do, l_val, arr[best_leaf]))
+                       .at[new_leaf].set(jnp.where(do, r_val, arr[new_leaf])))
+
+        new_cand = jax.tree_util.tree_map(upd_cand, state.cand, cand_left,
+                                          cand_right)
+
+        # ---- record for host-side tree assembly
+        # field order matches REC_* (= range(16))
+        rec = jnp.stack([
+            do.astype(jnp.float32), best_leaf.astype(jnp.float32),
+            info.feature.astype(jnp.float32),
+            info.threshold.astype(jnp.float32),
+            info.default_left.astype(jnp.float32),
+            best_gain.astype(jnp.float32), info.left_output.astype(jnp.float32),
+            info.right_output.astype(jnp.float32),
+            info.left_cnt.astype(jnp.float32),
+            info.right_cnt.astype(jnp.float32),
+            prev_output.astype(jnp.float32),
+            state.leaf_cnt[best_leaf].astype(jnp.float32),
+            info.left_sum_h.astype(jnp.float32),
+            info.right_sum_h.astype(jnp.float32),
+            info.left_sum_g.astype(jnp.float32),
+            info.right_sum_g.astype(jnp.float32)])
+        rec_f = state.rec_f.at[step_idx].set(rec)
+        rec_i = state.rec_i.at[step_idx].set(
+            jnp.stack([lc_bag, c_bag - lc_bag]).astype(jnp.int32))
+
+        return CompactState(
+            bins_p=bins_p, w_p=w_p, rid_p=rid_p, lid_p=lid_p,
+            leaf_start=leaf_start, leaf_wcnt=leaf_wcnt, hist_pool=hist_pool,
+            leaf_sum_g=leaf_sum_g, leaf_sum_h=leaf_sum_h, leaf_cnt=leaf_cnt,
+            leaf_output=leaf_output, leaf_depth=leaf_depth, cand=new_cand,
+            num_leaves=state.num_leaves + do.astype(jnp.int32),
+            rec_f=rec_f, rec_i=rec_i)
+
+    # -- whole tree ----------------------------------------------------------
+
+    def _train_tree_compact(self, grad, hess, bag, feature_mask):
+        self._hist_branches = [self._make_hist_branch(S)
+                               for S in self._win_sizes]
+        self._partition_branches = [self._make_partition_branch(S)
+                                    for S in self._win_sizes]
+        state = self._init_root_compact(grad, hess, bag, feature_mask)
+
+        def body(i, st):
+            return self._split_step_compact(st, feature_mask, i)
+
+        state = jax.lax.fori_loop(0, self.num_leaves - 1, body, state)
+        # leaf partition in ORIGINAL row order for the score updater
+        leaf_id = jnp.zeros(self.n_pad, jnp.int32).at[state.rid_p].set(
+            state.lid_p)
+        return state.rec_f, state.rec_i, leaf_id
+
+    # -- host orchestration --------------------------------------------------
+
+    def train(self, grad: jax.Array, hess: jax.Array, bag: jax.Array,
+              feature_mask: Optional[jax.Array] = None, fused: bool = True
+              ) -> Tuple[Tree, jax.Array]:
+        f = self.num_features
+        if feature_mask is None:
+            feature_mask = jnp.ones(f, dtype=bool)
+        rec_f, rec_i, leaf_id = self._jit_tree_c(grad, hess, bag, feature_mask)
+        rec_f = np.asarray(rec_f)  # single host sync per tree
+        rec_i = np.asarray(rec_i)
+        tree = self._assemble_compact(rec_f, rec_i)
+        return tree, leaf_id
+
+    def _assemble_compact(self, rec_f: np.ndarray, rec_i: np.ndarray) -> Tree:
+        tree = Tree(self.num_leaves)
+        used_map = self.data.used_feature_map
+        for i in range(rec_f.shape[0]):
+            r = rec_f[i]
+            if r[REC_VALID] < 0.5:
+                break
+            fi = int(r[REC_FEATURE])
+            thr_bin = int(r[REC_THRESHOLD])
+            mapper = self.data.bin_mappers[fi]
+            tree.split(
+                leaf=int(r[REC_LEAF]), feature_inner=fi,
+                real_feature=int(used_map[fi]),
+                threshold_bin=thr_bin,
+                threshold_double=mapper.bin_to_value(thr_bin),
+                left_value=float(r[REC_LEFT_OUT]),
+                right_value=float(r[REC_RIGHT_OUT]),
+                left_cnt=int(rec_i[i, 0]),
+                right_cnt=int(rec_i[i, 1]),
+                gain=float(r[REC_GAIN]),
+                missing_type=int(self.np_missing[fi]),
+                default_left=bool(r[REC_DEFAULT_LEFT] > 0.5))
+            tree.internal_value[tree.num_leaves - 2] = \
+                float(r[REC_INTERNAL_VALUE])
+        return tree
+
+
+def create_tree_learner(cfg: Config, data: _ConstructedDataset,
+                        hist_backend: str = "auto"):
+    """(tree_learner, device) → learner, the analogue of
+    ``TreeLearner::CreateTreeLearner`` (`src/treelearner/tree_learner.cpp:9-33`).
+
+    The compact learner is the default; the masked learner remains for
+    >256-bin datasets (bin codes don't pack 4-per-word) and for the GSPMD
+    parallel modes (whose sharding drapes over the masked learner's full-row
+    passes until the shard_map path lands).
+    """
+    mode = cfg.tpu_learner
+    if mode == "auto":
+        mode = "compact"
+    if mode == "compact":
+        if data.max_num_bin > 256 or cfg.tree_learner not in ("serial",):
+            mode = "masked"
+    if mode == "compact":
+        return CompactTPUTreeLearner(cfg, data, hist_backend)
+    return TPUTreeLearner(cfg, data, hist_backend)

@@ -100,3 +100,111 @@ def build_histogram_pallas(bins: jax.Array, w: jax.Array, *, num_bins: int,
             dimension_semantics=("parallel", "arbitrary")),
     )(bins, w)
     return out[:, :, :num_bins].transpose(0, 2, 1)
+
+
+# ---------------------------------------------------------------------------
+# Packed-word kernel for the compacted learner.
+#
+# Bin codes arrive packed 4-per-int32 word (feature 4k+s in byte s of word k)
+# so the partition sort moves 4 features per payload operand.  The weight
+# channels are split into two bf16 terms (w = hi + lo with the one-hot operand
+# exact in bf16), giving f32-product accuracy at two fast MXU passes instead
+# of the 6-pass ``Precision.HIGHEST`` emulation — the same single-precision
+# histogram regime the reference GPU kernels run in
+# (`docs/GPU-Performance.rst:137-141`), at ~2.5x the speed of HIGHEST here.
+# ---------------------------------------------------------------------------
+
+
+def _hist_kernel_packed(bins_ref, w_ref, out_ref, *, num_bins_padded: int,
+                        word_tile: int, nterms: int):
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    w_blk = w_ref[...]  # (3, Rb) f32
+    rb = w_blk.shape[1]
+    w_hi = w_blk.astype(jnp.bfloat16)
+    if nterms > 1:
+        w_lo = (w_blk - w_hi.astype(jnp.float32)).astype(jnp.bfloat16)
+    iota_b = jax.lax.broadcasted_iota(jnp.int32, (num_bins_padded, rb), 0)
+
+    for wd in range(word_tile):
+        word = bins_ref[wd, :]  # (Rb,) int32
+        for sub in range(4):
+            row = (word >> (8 * sub)) & 0xFF
+            onehot = (row[None, :] == iota_b).astype(jnp.bfloat16)  # (B, Rb)
+            part = jax.lax.dot_general(
+                w_hi, onehot, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)  # (3, B)
+            if nterms > 1:
+                part += jax.lax.dot_general(
+                    w_lo, onehot, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+            out_ref[wd * 4 + sub, :, :] += part
+
+
+@functools.partial(jax.jit, static_argnames=("num_bins", "word_tile",
+                                             "row_block", "nterms"))
+def build_histogram_packed(bins_words: jax.Array, w: jax.Array, *,
+                           num_bins: int, word_tile: int = 2,
+                           row_block: int = 2048, nterms: int = 2
+                           ) -> jax.Array:
+    """hist[f,b,c] = Σ_r [byte(bins_words[f//4,r], f%4)==b] · w[c,r].
+
+    bins_words : (Fw, S) int32 — 4 features per word, Fw a multiple of
+                 ``word_tile``; S a multiple of 1024.
+    w          : (3, S) f32 — (g·m, h·m, m), already masked.
+    Returns (Fw*4, num_bins, 3) f32.
+    """
+    fw, s = bins_words.shape
+    # Mosaic wants the block's leading dim divisible by 8 or equal to the
+    # full axis; pick the largest compliant word tile
+    if fw % word_tile or (word_tile % 8 and word_tile != fw):
+        word_tile = 8 if fw % 8 == 0 else fw
+    rb = min(row_block, s)
+    while s % rb:
+        rb //= 2
+    assert rb >= 128, (s, row_block)
+    b_pad = _round_up(num_bins, 128)
+    grid = (fw // word_tile, s // rb)
+    out = pl.pallas_call(
+        functools.partial(_hist_kernel_packed, num_bins_padded=b_pad,
+                          word_tile=word_tile, nterms=nterms),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((word_tile, rb), lambda i, j: (i, j)),
+            pl.BlockSpec((3, rb), lambda i, j: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((word_tile * 4, 3, b_pad),
+                               lambda i, j: (i, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((fw * 4, 3, b_pad), jnp.float32),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+    )(bins_words, w)
+    return out[:, :, :num_bins].transpose(0, 2, 1)
+
+
+def pack_bin_words(bins: jax.Array) -> jax.Array:
+    """(F, N) uint8 bin codes → (F/4, N) int32, feature 4k+s in byte s of
+    word k.  F must already be padded to a multiple of 4; codes above 255
+    do not fit a byte (the compact-learner factory routes >256-bin datasets
+    to the masked learner)."""
+    import jax.numpy as jnp
+
+    f, n = bins.shape
+    assert f % 4 == 0, f
+    assert bins.dtype == jnp.uint8, f"packable bins must be uint8, got {bins.dtype}"
+    b = bins.astype(jnp.int32).reshape(f // 4, 4, n)
+    return (b[:, 0] | (b[:, 1] << 8) | (b[:, 2] << 16) | (b[:, 3] << 24))
+
+
+def unpack_bin_words(words: jax.Array, num_features: int) -> jax.Array:
+    """(Fw, S) int32 → (num_features, S) int32 bin codes."""
+    import jax.numpy as jnp
+
+    fw, s = words.shape
+    parts = [(words >> (8 * i)) & 0xFF for i in range(4)]
+    out = jnp.stack(parts, axis=1).reshape(fw * 4, s)
+    return out[:num_features]

@@ -306,6 +306,19 @@ class Tree:
             "num_leaves": self.num_leaves,
         }
 
+    def __deepcopy__(self, memo):
+        # device-array caches (_traverse_pack holds a weakref to a dataset
+        # and jax arrays) must not survive a copy — they are rebuilt lazily
+        import copy as _copy
+
+        out = self.__class__(self.max_leaves)
+        memo[id(self)] = out
+        for k, v in self.__dict__.items():
+            if k in ("_traverse_pack",):
+                continue
+            setattr(out, k, _copy.deepcopy(v, memo))
+        return out
+
     def leaf_output(self, leaf: int) -> float:
         return float(self.leaf_value[leaf])
 

@@ -1,0 +1,122 @@
+"""Compact (leaf-contiguous) learner vs the masked reference learner.
+
+The compact learner re-derives every histogram from windowed passes over
+permuted rows; these tests pin it to the masked learner's output exactly —
+same split features, same bin thresholds, same leaf partition — in both f32
+and f64 accounting, plus unit coverage for the packed-word bin transport.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.learner import TPUTreeLearner
+from lightgbm_tpu.learner_compact import (CompactTPUTreeLearner,
+                                          create_tree_learner)
+from lightgbm_tpu.ops.hist_pallas import pack_bin_words, unpack_bin_words
+
+
+def _make(rng, n=3000, f=9, missing=True):
+    X = rng.randn(n, f)
+    if missing:
+        X[rng.rand(n, f) < 0.08] = np.nan
+        X[:, 1] = np.where(rng.rand(n) < 0.3, 0.0, X[:, 1])  # zero-heavy
+    y = (X[:, 0] * 1.5 + np.nan_to_num(X[:, 1]) - 0.5 * X[:, 2]
+         + 0.3 * rng.randn(n) > 0).astype(float)
+    return X, y
+
+
+def _grad_hess(y, n_pad):
+    n = len(y)
+    grad = np.zeros(n_pad, np.float32)
+    grad[:n] = np.where(y, -0.5, 0.5)
+    hess = np.zeros(n_pad, np.float32)
+    hess[:n] = 0.25
+    bag = np.zeros(n_pad, np.float32)
+    bag[:n] = 1.0
+    return jnp.asarray(grad), jnp.asarray(hess), jnp.asarray(bag)
+
+
+def _trees_equal(t1, t2):
+    ni = t1.num_leaves - 1
+    return (t1.num_leaves == t2.num_leaves
+            and np.array_equal(t1.split_feature[:ni], t2.split_feature[:ni])
+            and np.array_equal(t1.threshold_in_bin[:ni],
+                               t2.threshold_in_bin[:ni])
+            and np.array_equal(t1.leaf_count[:t1.num_leaves],
+                               t2.leaf_count[:t2.num_leaves])
+            and np.allclose(t1.leaf_value[:t1.num_leaves],
+                            t2.leaf_value[:t2.num_leaves],
+                            rtol=1e-5, atol=1e-7))
+
+
+@pytest.mark.parametrize("dp", [False, True])
+def test_compact_equals_masked(rng, dp):
+    X, y = _make(rng)
+    params = {"objective": "binary", "num_leaves": 31, "min_data_in_leaf": 20,
+              "gpu_use_dp": dp}
+    d = lgb.Dataset(X, label=y, params=params).construct().constructed
+    cfg = Config.from_params(params)
+    masked = TPUTreeLearner(cfg, d)
+    compact = CompactTPUTreeLearner(cfg, d)
+    grad, hess, bag = _grad_hess(y, d.num_data_padded)
+    t1, lid1 = masked.train(grad, hess, bag)
+    t2, lid2 = compact.train(grad, hess, bag)
+    assert _trees_equal(t1, t2)
+    assert np.array_equal(np.asarray(lid1), np.asarray(lid2))
+
+
+def test_compact_equals_masked_with_bagging_mask(rng):
+    X, y = _make(rng, missing=False)
+    params = {"objective": "binary", "num_leaves": 15, "min_data_in_leaf": 5}
+    d = lgb.Dataset(X, label=y, params=params).construct().constructed
+    cfg = Config.from_params(params)
+    masked = TPUTreeLearner(cfg, d)
+    compact = CompactTPUTreeLearner(cfg, d)
+    grad, hess, bag = _grad_hess(y, d.num_data_padded)
+    drop = jnp.asarray((rng.rand(d.num_data_padded) < 0.4).astype(np.float32))
+    bag = bag * (1.0 - drop)
+    t1, _ = masked.train(grad, hess, bag)
+    t2, _ = compact.train(grad, hess, bag)
+    assert _trees_equal(t1, t2)
+
+
+def test_compact_small_windows(rng):
+    """Force multiple window buckets even on a small dataset."""
+    X, y = _make(rng, n=5000, missing=False)
+    params = {"objective": "binary", "num_leaves": 63, "min_data_in_leaf": 5,
+              "tpu_min_window": 1000}  # rounds up to 1024
+    d = lgb.Dataset(X, label=y, params=params).construct().constructed
+    cfg = Config.from_params(params)
+    compact = CompactTPUTreeLearner(cfg, d)
+    assert len(compact._win_sizes) > 1
+    masked = TPUTreeLearner(cfg, d)
+    grad, hess, bag = _grad_hess(y, d.num_data_padded)
+    t1, _ = masked.train(grad, hess, bag)
+    t2, _ = compact.train(grad, hess, bag)
+    assert _trees_equal(t1, t2)
+
+
+def test_pack_unpack_roundtrip(rng):
+    bins = rng.randint(0, 256, size=(8, 2048)).astype(np.uint8)
+    words = pack_bin_words(jnp.asarray(bins))
+    assert words.shape == (2, 2048)
+    back = np.asarray(unpack_bin_words(words, 8))
+    assert np.array_equal(back, bins.astype(np.int32))
+
+
+def test_factory_routing():
+    X = np.random.RandomState(0).randn(300, 4)
+    y = (X[:, 0] > 0).astype(float)
+    params = {"objective": "binary", "min_data_in_leaf": 5}
+    d = lgb.Dataset(X, label=y, params=params).construct().constructed
+    assert isinstance(create_tree_learner(Config.from_params(params), d),
+                      CompactTPUTreeLearner)
+    cfg2 = Config.from_params({**params, "tpu_learner": "masked"})
+    l2 = create_tree_learner(cfg2, d)
+    assert not isinstance(l2, CompactTPUTreeLearner)
+    cfg3 = Config.from_params({**params, "tree_learner": "data"})
+    l3 = create_tree_learner(cfg3, d)
+    assert not isinstance(l3, CompactTPUTreeLearner)
