@@ -18,6 +18,8 @@ from .gbdt import GBDT, _traverse_tree_binned
 
 class DART(GBDT):
     name = "dart"
+    # drop/normalize touch host trees every iteration — no async pipeline
+    _supports_pipeline = False
 
     def __init__(self, cfg, train_data=None, objective=None):
         self.tree_weight: List[float] = []

@@ -113,29 +113,43 @@ def _traverse_tree_binned(data: _ConstructedDataset, tree: Tree) -> jax.Array:
     """Vectorized inner-bin traversal (``NumericalDecisionInner``,
     `tree.h:233-249`) over all rows of a binned dataset.
 
-    The per-node device arrays depend only on the tree and the (shared) bin
-    mappers, so they are built once per tree and cached on it — train and
-    valid sets reuse the same pack.
+    The per-node device arrays depend only on the tree and the bin mappers,
+    so they are cached per bin-space (reference-linked valid sets share the
+    train set's mapper list, `dataset.py:329`, and reuse one pack) — a
+    train/valid/train alternation does not rebuild.
     """
     import weakref
 
     ni = tree.num_leaves - 1
-    pack = getattr(tree, "_traverse_pack", None)
-    if pack is None or pack[0] != tree.num_leaves or pack[-1]() is not data:
+    packs = getattr(tree, "_traverse_pack", None)
+    if packs is None or packs[0] != tree.num_leaves:
+        packs = (tree.num_leaves, {})
+        tree._traverse_pack = packs
+    # keyed by the mapper list's id (reference-linked valid sets share the
+    # train set's list and reuse one pack), guarded by a weakref to a dataset
+    # owning that list so a recycled address after GC can never serve a
+    # stale bin space
+    key = id(data.bin_mappers)
+    entry = packs[1].get(key)
+    pack = None
+    if entry is not None:
+        owner = entry[0]()
+        if owner is not None and owner.bin_mappers is data.bin_mappers:
+            pack = entry[1]
+    if pack is None:
         num_bin, missing, default_bin, _ = data.feature_meta_arrays()
         feat = tree.split_feature_inner[:ni]
         depth = int(tree.leaf_depth[:tree.num_leaves].max())
-        pack = (tree.num_leaves, depth,
+        pack = (depth,
                 jnp.asarray(feat), jnp.asarray(tree.threshold_in_bin[:ni]),
                 jnp.asarray(missing[feat]), jnp.asarray(default_bin[feat]),
                 jnp.asarray(num_bin[feat] - 1),
                 jnp.asarray((tree.decision_type[:ni] & 2) != 0),
                 jnp.asarray(tree.left_child[:ni]),
-                jnp.asarray(tree.right_child[:ni]),
-                weakref.ref(data))  # bin-space owner, part of the cache key
-        tree._traverse_pack = pack
-    _, depth, feat, thr, node_missing, node_default_bin, node_nan_bin, \
-        node_default_left, left_child, right_child, _ = pack
+                jnp.asarray(tree.right_child[:ni]))
+        packs[1][key] = (weakref.ref(data), pack)
+    depth, feat, thr, node_missing, node_default_bin, node_nan_bin, \
+        node_default_left, left_child, right_child = pack
     # leaf values change under DART re-shrinkage, so always ship them fresh
     leaf_value = jnp.asarray(tree.leaf_value[:tree.num_leaves]
                              .astype(np.float32))
@@ -172,15 +186,38 @@ def _traverse_jit(bins, feat, thr, node_missing, node_default_bin,
     return leaf_value[leaf]
 
 
+@functools.partial(jax.jit, static_argnames=("k",), donate_argnums=(0,))
+def _score_add_leaf(score, leaf_output, leaf_id, lr, k):
+    """Device-side training-score update from the learner's final leaf
+    partition — the sync-free fast path of ``ScoreUpdater.add_by_leaf_id``."""
+    return score.at[k].add(lr * jnp.take(leaf_output, leaf_id))
+
+
 class GBDT:
-    """Reference `src/boosting/gbdt.h:24`."""
+    """Reference `src/boosting/gbdt.h:24`.
+
+    The boosting loop is PIPELINED when the objective doesn't renew leaf
+    outputs and there are no validation sets: every per-iteration step
+    (gradients, tree build, score update) stays on device with zero host
+    syncs, and the small per-split record arrays are fetched lazily — host
+    trees are assembled only when something actually reads ``self.models``
+    (eval, save, predict).  On a remote-attached TPU this removes the
+    dominant cost of an iteration (host round trips), the analogue of the
+    reference keeping its whole iteration inside the OpenMP region.
+    """
 
     name = "gbdt"
+    _supports_pipeline = True
 
     def __init__(self, cfg: Config, train_data: Optional[Dataset] = None,
                  objective: Optional[ObjectiveFunction] = None):
         self.cfg = cfg
         self.iter_ = 0
+        self._pending: List[tuple] = []
+        self._stopped = False
+        self._jit_grad_fn = None
+        self._lr_dev = None
+        self._lr_dev_val = None
         self.models: List[Tree] = []
         self.train_data: Optional[_ConstructedDataset] = None
         self.objective = objective
@@ -207,6 +244,73 @@ class GBDT:
         self.eval_history: Dict[str, Dict[str, List[float]]] = {}
         if train_data is not None:
             self.init(train_data, objective)
+
+    # -- pipelined tree materialization --------------------------------------
+
+    @property
+    def models(self) -> List[Tree]:
+        self._flush_pending()
+        return self._models
+
+    @models.setter
+    def models(self, value) -> None:
+        self._flush_pending()
+        self._models = list(value)
+
+    def _flush_pending(self) -> None:
+        """Assemble host trees for every pipelined iteration dispatched so
+        far, then run the deferred no-more-splits stop check
+        (`gbdt.cpp:379-387` in the sync loop)."""
+        pend = getattr(self, "_pending", None)
+        if not pend:
+            return
+        self._pending = []
+        first_idx = len(self._models)
+        for idx, rec_f, rec_i, init_sc in pend:
+            tree = self.learner.assemble_host(rec_f, rec_i)
+            if tree.num_leaves > 1:
+                tree.apply_shrinkage(self.shrinkage_rate)
+                if abs(init_sc) > kEpsilon:
+                    tree.leaf_value[:tree.num_leaves] += init_sc
+                    tree.shrinkage = 1.0
+            elif idx < self.num_tree_per_iteration:
+                # nothing splittable on the very first iteration: keep the
+                # boost-from-average constant model and add its output to the
+                # training score, matching the sync path (`gbdt.cpp:395-404`)
+                tree.leaf_value[0] = init_sc
+                if abs(init_sc) > kEpsilon:
+                    self.train_score.add_constant(init_sc,
+                                                  idx % self.num_tree_per_iteration)
+            self._models[idx] = tree
+            first_idx = min(first_idx, idx)
+        # deferred stop detection over the flushed iterations only: the first
+        # iteration in which NO class grew a tree ends training; later
+        # iterations repeated the draw and are dropped (`gbdt.cpp:379-387`),
+        # including rolling their contributions back out of the training
+        # score (under bagging a later draw may have split)
+        k = max(self.num_tree_per_iteration, 1)
+        for it in range(first_idx // k, len(self._models) // k):
+            trees = self._models[it * k:(it + 1) * k]
+            if trees and all(t is not None and t.num_leaves <= 1
+                             for t in trees):
+                # keep iteration 0's constant trees (the sync path's
+                # first-iteration case keeps them too); everything after the
+                # stop iteration is rolled back and dropped
+                drop_from = max(it, 1) * k
+                for di in range(drop_from, len(self._models)):
+                    t = self._models[di]
+                    if t is not None and t.num_leaves > 1:
+                        t.apply_shrinkage(-1.0)
+                        delta = _traverse_tree_binned(self.train_data, t)
+                        self.train_score.score = \
+                            self.train_score.score.at[di % k].add(delta)
+                del self._models[drop_from:]
+                self.iter_ = it
+                self._stopped = True
+                import warnings
+                warnings.warn("Stopped training because there are no more "
+                              "leaves that meet the split requirements")
+                break
 
     # -- GBDT::Init (`gbdt.cpp:45-137`) -------------------------------------
 
@@ -287,7 +391,10 @@ class GBDT:
         f = self.train_data.num_used_features
         frac = self.cfg.feature_fraction
         if frac >= 1.0:
-            return jnp.ones(f, dtype=bool)
+            if getattr(self, "_full_fmask", None) is None \
+                    or self._full_fmask.shape[0] != f:
+                self._full_fmask = jnp.ones(f, dtype=bool)
+            return self._full_fmask
         used = max(1, int(round(f * frac)))
         idx = self._feat_rng.choice(f, used, replace=False)
         mask = np.zeros(f, dtype=bool)
@@ -297,17 +404,25 @@ class GBDT:
     # -- gradients -----------------------------------------------------------
 
     def _compute_gradients(self) -> Tuple[jnp.ndarray, jnp.ndarray]:
-        """(K, N_pad) gradients/hessians from the objective (`gbdt.cpp:149`)."""
-        obj = self.objective
-        score = self.train_score.score
-        if obj.name == "multiclass":
-            return obj.get_gradients_all(score)
-        gs, hs = [], []
-        for k in range(self.num_tree_per_iteration):
-            g, h = obj.get_gradients(score[k], k)
-            gs.append(g)
-            hs.append(h)
-        return jnp.stack(gs), jnp.stack(hs)
+        """(K, N_pad) gradients/hessians from the objective (`gbdt.cpp:149`),
+        as ONE jitted dispatch (the objective's label arrays are closed over;
+        they are fixed for the life of the booster)."""
+        if self._jit_grad_fn is None:
+            obj = self.objective
+            K = self.num_tree_per_iteration
+
+            def grad_all(score):
+                if obj.name == "multiclass":
+                    return obj.get_gradients_all(score)
+                gs, hs = [], []
+                for k in range(K):
+                    g, h = obj.get_gradients(score[k], k)
+                    gs.append(g)
+                    hs.append(h)
+                return jnp.stack(gs), jnp.stack(hs)
+
+            self._jit_grad_fn = jax.jit(grad_all)
+        return self._jit_grad_fn(self.train_score.score)
 
     # -- one boosting iteration (`gbdt.cpp:333-413`) -------------------------
 
@@ -325,6 +440,8 @@ class GBDT:
     def train_one_iter(self, gradients: Optional[np.ndarray] = None,
                        hessians: Optional[np.ndarray] = None) -> bool:
         """Returns True when training cannot continue (no splittable leaves)."""
+        if self._stopped:
+            return True
         init_scores = [0.0] * self.num_tree_per_iteration
         if gradients is None or hessians is None:
             for k in range(self.num_tree_per_iteration):
@@ -335,9 +452,42 @@ class GBDT:
         self._bagging(self.iter_)
         return self._train_trees(grad, hess, init_scores)
 
+    def _can_pipeline(self) -> bool:
+        return (self._supports_pipeline
+                and self.objective is not None
+                and not self.objective.needs_renew_tree_output
+                and not self.valid_scores
+                and all(self.class_need_train)
+                and self.train_data.num_used_features > 0
+                and hasattr(self.learner, "train_async"))
+
+    def _train_trees_pipelined(self, grad, hess, init_scores) -> bool:
+        """Sync-free iteration: tree build + device score update dispatched
+        asynchronously; host trees materialize lazily in ``_flush_pending``."""
+        if self.shrinkage_rate != self._lr_dev_val:
+            self._lr_dev = jnp.float32(self.shrinkage_rate)
+            self._lr_dev_val = self.shrinkage_rate
+        for k in range(self.num_tree_per_iteration):
+            fmask = self._feature_sample()
+            rec_f, rec_i, leaf_id, leaf_out = self.learner.train_async(
+                grad[k], hess[k], self._bag_mask, fmask)
+            self.train_score.score = _score_add_leaf(
+                self.train_score.score, leaf_out, leaf_id, self._lr_dev, k)
+            self._pending.append((len(self._models), rec_f, rec_i,
+                                  init_scores[k]))
+            self._models.append(None)
+        self.iter_ += 1
+        # bound stop-detection staleness without stalling the pipeline: the
+        # arrays synced here finished many iterations ago
+        if len(self._pending) >= 16 * self.num_tree_per_iteration:
+            self._flush_pending()
+        return self._stopped
+
     def _train_trees(self, grad, hess, init_scores) -> bool:
         """Per-class tree loop shared by GBDT/GOSS/DART
         (`gbdt.cpp:348-413`)."""
+        if self._can_pipeline():
+            return self._train_trees_pipelined(grad, hess, init_scores)
         should_continue = False
         for k in range(self.num_tree_per_iteration):
             new_tree = Tree(2)
@@ -388,7 +538,8 @@ class GBDT:
 
     def _boost_from_average(self, class_id: int, update_scorer: bool) -> float:
         """`gbdt.cpp:309-331`."""
-        if self.models or self.train_score.has_init_score or self.objective is None:
+        if self._models or self.train_score.has_init_score \
+                or self.objective is None:
             return 0.0
         if not (self.cfg.boost_from_average or self.train_data.num_used_features == 0):
             return 0.0

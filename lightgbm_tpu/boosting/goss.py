@@ -36,6 +36,8 @@ class GOSS(GBDT):
         pass
 
     def train_one_iter(self, gradients=None, hessians=None) -> bool:
+        if self._stopped:
+            return True
         init_scores = [0.0] * self.num_tree_per_iteration
         if gradients is None or hessians is None:
             for k in range(self.num_tree_per_iteration):

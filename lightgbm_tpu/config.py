@@ -318,6 +318,10 @@ class Config:
     # implementation / fallback); "auto" = compact
     tpu_learner: str = "auto"
     tpu_min_window: int = 2048  # smallest compacted histogram window
+    # packed-histogram MXU precision: "bf16x2" (default; ~16 weight mantissa
+    # bits, two MXU passes), "bf16x3" (~24 bits, three passes), or "highest"
+    # (full f32 emulation, ~6 passes) for validation runs
+    tpu_hist_precision: str = "bf16x2"
 
     # derived (not user-settable)
     is_parallel: bool = field(default=False, repr=False)

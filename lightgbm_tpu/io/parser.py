@@ -7,8 +7,9 @@ the first lines, supports a leading label column, and picks up the sidecar
 ``.weight`` / ``.query`` files and ``.init`` init-score files exactly like
 ``Metadata`` loading (`src/io/metadata.cpp`).
 
-A C++ fast path (``lightgbm_tpu/cpp``) parses large files when the native
-extension is built; this numpy fallback is always available.
+A C++ fast path (``lightgbm_tpu.native``, auto-built on first import via
+``python -m lightgbm_tpu.native.build``) parses large dense files when a
+toolchain is available; the numpy fallback is always available.
 """
 
 from __future__ import annotations
@@ -51,14 +52,23 @@ def load_data_file(path: str, params: Optional[Dict] = None
     if kind == "libsvm":
         mat, label = _parse_libsvm(lines)
     else:
+        mat = None
         try:
-            from ..cpp import parse_dense  # native fast path when built
-            mat = parse_dense(path, delim, 1 if has_header else 0)
-        except Exception:
-            mat = np.asarray(
-                [np.fromstring(ln, dtype=np.float64,
-                               sep=delim if delim != " " else " ")
-                 for ln in lines])
+            from ..native import parse_dense  # C++ fast path when built
+            mat = parse_dense(path, delim or " ", 1 if has_header else 0)
+        except ImportError:
+            pass
+        if mat is None:
+            if delim == " ":
+                # whitespace-delimited: collapse runs of spaces/tabs
+                tok_rows = (ln.split() for ln in lines)
+            else:
+                # delimited: interior empty fields parse as NaN; trailing
+                # delimiters are ignored (np.fromstring's old behavior)
+                tok_rows = (ln.rstrip(delim).split(delim) for ln in lines)
+            mat = np.asarray([np.fromiter(
+                (float(x) if x.strip() else np.nan for x in toks),
+                dtype=np.float64) for toks in tok_rows])
         label_idx = 0
         if isinstance(label_column, str) and label_column.startswith("column_"):
             label_idx = int(label_column.split("_", 1)[1])

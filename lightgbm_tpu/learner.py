@@ -302,6 +302,19 @@ class TPUTreeLearner:
 
     # -- host orchestration --------------------------------------------------
 
+    def train_async(self, grad: jax.Array, hess: jax.Array, bag: jax.Array,
+                    feature_mask: Optional[jax.Array] = None):
+        """Dispatch one tree build; returns device arrays with NO host sync:
+        (rec_f, rec_i, leaf_id, leaf_output).  rec_i is None for the masked
+        learner (counts live in the f32 record)."""
+        if feature_mask is None:
+            feature_mask = jnp.ones(self.num_features, dtype=bool)
+        state = self._jit_tree(grad, hess, bag, feature_mask)
+        return state.records, None, state.leaf_id, state.leaf_output
+
+    def assemble_host(self, rec_f, rec_i) -> Tree:
+        return self._assemble(np.asarray(rec_f))
+
     def train(self, grad: jax.Array, hess: jax.Array, bag: jax.Array,
               feature_mask: Optional[jax.Array] = None, fused: bool = True
               ) -> Tuple[Tree, jax.Array]:

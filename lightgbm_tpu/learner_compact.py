@@ -97,13 +97,21 @@ class CompactTPUTreeLearner(TPUTreeLearner):
         self._use_pallas = (hist_backend in ("auto", "pallas")
                             and _on_tpu() and not self.hist_dp
                             and self.n_pad % 1024 == 0)
+        prec_map = {"bf16x2": 2, "bf16x3": 3, "highest": 0}
+        if cfg.tpu_hist_precision not in prec_map:
+            raise ValueError(f"tpu_hist_precision must be one of "
+                             f"{sorted(prec_map)}, got {cfg.tpu_hist_precision}")
+        self._hist_nterms = prec_map[cfg.tpu_hist_precision]
         self._jit_tree_c = jax.jit(self._train_tree_compact)
 
     # -- packed bins ---------------------------------------------------------
 
     def bins_packed(self) -> jax.Array:
         if self._bins_packed is None:
-            self._bins_packed = pack_bin_words(self.data.device_bins())
+            packed = pack_bin_words(self.data.device_bins())
+            if isinstance(packed, jax.core.Tracer):
+                return packed  # called under trace — don't cache the tracer
+            self._bins_packed = packed
         return self._bins_packed
 
     # -- bucket helpers ------------------------------------------------------
@@ -127,7 +135,8 @@ class CompactTPUTreeLearner(TPUTreeLearner):
             m = ((pos >= off) & (pos < off + cnt))
             wm = ww * m[None, :].astype(ww.dtype)
             if self._use_pallas:
-                h = build_histogram_packed(bw, wm, num_bins=b)[:f]
+                h = build_histogram_packed(bw, wm, num_bins=b,
+                                           nterms=self._hist_nterms)[:f]
             else:
                 bu = unpack_bin_words(bw, f)
                 h = build_histogram_onehot(bu, wm, num_bins=b, dp=self.hist_dp)
@@ -289,10 +298,12 @@ class CompactTPUTreeLearner(TPUTreeLearner):
         rc_w = c - lc_w
 
         # ---- smaller-child histogram + sibling subtraction
-        # (`serial_tree_learner.cpp:371-385`)
-        left_smaller = lc_w <= rc_w
+        # (`serial_tree_learner.cpp:371-385`); the smaller child is chosen by
+        # BAGGED counts like the reference (left_cnt <= right_cnt), while the
+        # slice itself is that child's window
+        left_smaller = lc_bag <= (c_bag - lc_bag)
         small_start = jnp.where(left_smaller, s, s + lc_w)
-        small_cnt = jnp.minimum(lc_w, rc_w)
+        small_cnt = jnp.where(left_smaller, lc_w, rc_w)
         hidx = self._bucket_idx(jnp.maximum(small_cnt, 1))
         hist_small = lax.switch(hidx, self._hist_branches, bins_p, w_p,
                                 small_start, small_cnt)
@@ -380,20 +391,28 @@ class CompactTPUTreeLearner(TPUTreeLearner):
         # leaf partition in ORIGINAL row order for the score updater
         leaf_id = jnp.zeros(self.n_pad, jnp.int32).at[state.rid_p].set(
             state.lid_p)
-        return state.rec_f, state.rec_i, leaf_id
+        return state.rec_f, state.rec_i, leaf_id, state.leaf_output
 
     # -- host orchestration --------------------------------------------------
+
+    def train_async(self, grad: jax.Array, hess: jax.Array, bag: jax.Array,
+                    feature_mask: Optional[jax.Array] = None):
+        """Dispatch one tree build; returns device arrays with NO host sync:
+        (rec_f, rec_i, leaf_id, leaf_output)."""
+        if feature_mask is None:
+            feature_mask = jnp.ones(self.num_features, dtype=bool)
+        self.bins_packed()  # materialize the cache outside the trace
+        return self._jit_tree_c(grad, hess, bag, feature_mask)
+
+    def assemble_host(self, rec_f, rec_i) -> Tree:
+        return self._assemble_compact(np.asarray(rec_f), np.asarray(rec_i))
 
     def train(self, grad: jax.Array, hess: jax.Array, bag: jax.Array,
               feature_mask: Optional[jax.Array] = None, fused: bool = True
               ) -> Tuple[Tree, jax.Array]:
-        f = self.num_features
-        if feature_mask is None:
-            feature_mask = jnp.ones(f, dtype=bool)
-        rec_f, rec_i, leaf_id = self._jit_tree_c(grad, hess, bag, feature_mask)
-        rec_f = np.asarray(rec_f)  # single host sync per tree
-        rec_i = np.asarray(rec_i)
-        tree = self._assemble_compact(rec_f, rec_i)
+        rec_f, rec_i, leaf_id, _ = self.train_async(grad, hess, bag,
+                                                    feature_mask)
+        tree = self.assemble_host(rec_f, rec_i)
         return tree, leaf_id
 
     def _assemble_compact(self, rec_f: np.ndarray, rec_i: np.ndarray) -> Tree:

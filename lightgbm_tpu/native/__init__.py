@@ -1,0 +1,63 @@
+"""Native (C++) runtime pieces, loaded via ctypes.
+
+Currently: the dense text parser fast path (``parse_dense``) — the analogue
+of the reference's OpenMP text parsing (`src/io/parser.cpp`,
+`src/io/dataset_loader.cpp:160-264`).  The library auto-builds on first
+import when a C++ toolchain is available; without one, importing names from
+this package raises ImportError and callers fall back to numpy paths.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import warnings
+
+import numpy as np
+
+from . import build as _build
+
+_lib = None
+
+
+def _load():
+    global _lib
+    if _lib is not None:
+        return _lib
+    if _build.is_stale():
+        try:
+            _build.build(quiet=True)
+        except Exception as e:  # no toolchain / compile error → soft-fail
+            raise ImportError(f"lightgbm_tpu native library unavailable: {e}")
+    lib = ctypes.CDLL(_build.TARGET)
+    lib.lgbt_parse_dense.restype = ctypes.c_long
+    lib.lgbt_parse_dense.argtypes = [
+        ctypes.c_char_p, ctypes.c_char, ctypes.c_int,
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_double)),
+        ctypes.POINTER(ctypes.c_long), ctypes.POINTER(ctypes.c_long)]
+    lib.lgbt_free.restype = None
+    lib.lgbt_free.argtypes = [ctypes.POINTER(ctypes.c_double)]
+    _lib = lib
+    return lib
+
+
+def parse_dense(path: str, delim: str = " ", skip_rows: int = 0) -> np.ndarray:
+    """Parse a dense delimited text file to an (rows, cols) f64 matrix.
+
+    delim ' ' means any run of spaces/tabs; otherwise a single-char
+    delimiter with interior empty fields as NaN (numpy-fallback parity).
+    """
+    lib = _load()
+    data = ctypes.POINTER(ctypes.c_double)()
+    rows = ctypes.c_long()
+    cols = ctypes.c_long()
+    rc = lib.lgbt_parse_dense(path.encode(), delim.encode(), skip_rows,
+                              ctypes.byref(data), ctypes.byref(rows),
+                              ctypes.byref(cols))
+    if rc < 0:
+        raise IOError(f"native parse of {path!r} failed (code {rc})")
+    try:
+        out = np.ctypeslib.as_array(data, shape=(rows.value, cols.value)).copy()
+    finally:
+        lib.lgbt_free(data)
+    return out

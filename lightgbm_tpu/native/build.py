@@ -1,0 +1,43 @@
+"""Build the native shared library: ``python -m lightgbm_tpu.native.build``.
+
+Compiles ``parse.cpp`` (and any future native sources) into ``_native.so``
+next to this file with g++.  ``lightgbm_tpu.native`` also attempts this
+automatically on first import when the library is missing or stale.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+SOURCES = [os.path.join(_HERE, "parse.cpp")]
+TARGET = os.path.join(_HERE, "_native.so")
+
+
+def build(quiet: bool = False) -> str:
+    cxx = os.environ.get("CXX") or shutil.which("g++") or shutil.which("c++")
+    if cxx is None:
+        raise RuntimeError("no C++ compiler found (set $CXX)")
+    cmd = [cxx, "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+           "-o", TARGET] + SOURCES
+    res = subprocess.run(cmd, capture_output=True, text=True)
+    if res.returncode != 0:
+        raise RuntimeError(f"native build failed:\n{res.stderr}")
+    if not quiet:
+        print(f"built {TARGET}")
+    return TARGET
+
+
+def is_stale() -> bool:
+    if not os.path.exists(TARGET):
+        return True
+    t = os.path.getmtime(TARGET)
+    return any(os.path.getmtime(s) > t for s in SOURCES)
+
+
+if __name__ == "__main__":
+    build()
+    sys.exit(0)

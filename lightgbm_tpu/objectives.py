@@ -82,6 +82,14 @@ class ObjectiveFunction:
                           mask: np.ndarray) -> None:
         """Leaf refinement hook (`objective_function.h:58-66`); default no-op."""
 
+    @property
+    def needs_renew_tree_output(self) -> bool:
+        """True when this objective overrides ``renew_tree_output`` — the
+        boosting loop then pulls scores to host per iteration; objectives
+        that don't renew skip that sync entirely."""
+        return type(self).renew_tree_output is not \
+            ObjectiveFunction.renew_tree_output
+
     def to_string(self) -> str:
         return self.name
 

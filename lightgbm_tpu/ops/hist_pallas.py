@@ -107,11 +107,15 @@ def build_histogram_pallas(bins: jax.Array, w: jax.Array, *, num_bins: int,
 #
 # Bin codes arrive packed 4-per-int32 word (feature 4k+s in byte s of word k)
 # so the partition sort moves 4 features per payload operand.  The weight
-# channels are split into two bf16 terms (w = hi + lo with the one-hot operand
-# exact in bf16), giving f32-product accuracy at two fast MXU passes instead
-# of the 6-pass ``Precision.HIGHEST`` emulation — the same single-precision
-# histogram regime the reference GPU kernels run in
-# (`docs/GPU-Performance.rst:137-141`), at ~2.5x the speed of HIGHEST here.
+# channels are split into ``nterms`` bf16 terms (w ≈ hi + lo, the one-hot
+# operand is exact in bf16), so each weight carries ~8·nterms mantissa bits
+# (nterms=2 → ~16 bits, noticeably below f32's 24; accumulation itself is
+# f32).  That is coarser than the reference GPU kernels' full-f32 regime
+# (`docs/GPU-Performance.rst:137-141`) but runs at nterms MXU passes instead
+# of the ~6-pass ``Precision.HIGHEST`` emulation; near-tie splits can differ
+# from the f32 path.  ``nterms=3`` (~24 bits) or the config knob
+# ``tpu_hist_precision=highest`` (full f32 emulation) recover f32-grade
+# histograms for validation runs.
 # ---------------------------------------------------------------------------
 
 
@@ -125,32 +129,45 @@ def _hist_kernel_packed(bins_ref, w_ref, out_ref, *, num_bins_padded: int,
 
     w_blk = w_ref[...]  # (3, Rb) f32
     rb = w_blk.shape[1]
-    w_hi = w_blk.astype(jnp.bfloat16)
-    if nterms > 1:
-        w_lo = (w_blk - w_hi.astype(jnp.float32)).astype(jnp.bfloat16)
+    if nterms > 0:
+        # bf16 term expansion: residual after t terms carries ~8(t+1) bits
+        terms = []
+        resid = w_blk
+        for _ in range(nterms):
+            t = resid.astype(jnp.bfloat16)
+            terms.append(t)
+            resid = resid - t.astype(jnp.float32)
     iota_b = jax.lax.broadcasted_iota(jnp.int32, (num_bins_padded, rb), 0)
 
     for wd in range(word_tile):
         word = bins_ref[wd, :]  # (Rb,) int32
         for sub in range(4):
             row = (word >> (8 * sub)) & 0xFF
-            onehot = (row[None, :] == iota_b).astype(jnp.bfloat16)  # (B, Rb)
-            part = jax.lax.dot_general(
-                w_hi, onehot, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32)  # (3, B)
-            if nterms > 1:
-                part += jax.lax.dot_general(
-                    w_lo, onehot, (((1,), (1,)), ((), ())),
-                    preferred_element_type=jnp.float32)
+            if nterms > 0:
+                onehot = (row[None, :] == iota_b).astype(jnp.bfloat16)
+                part = jax.lax.dot_general(
+                    terms[0], onehot, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32)  # (3, B)
+                for t in terms[1:]:
+                    part += jax.lax.dot_general(
+                        t, onehot, (((1,), (1,)), ((), ())),
+                        preferred_element_type=jnp.float32)
+            else:  # nterms == 0: full f32 emulation (tpu_hist_precision=highest)
+                onehot = (row[None, :] == iota_b).astype(jnp.float32)
+                part = jax.lax.dot_general(
+                    w_blk, onehot, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                    precision=jax.lax.Precision.HIGHEST)
             out_ref[wd * 4 + sub, :, :] += part
 
 
 @functools.partial(jax.jit, static_argnames=("num_bins", "word_tile",
-                                             "row_block", "nterms"))
+                                             "row_block", "nterms",
+                                             "interpret"))
 def build_histogram_packed(bins_words: jax.Array, w: jax.Array, *,
                            num_bins: int, word_tile: int = 2,
-                           row_block: int = 2048, nterms: int = 2
-                           ) -> jax.Array:
+                           row_block: int = 2048, nterms: int = 2,
+                           interpret: bool = False) -> jax.Array:
     """hist[f,b,c] = Σ_r [byte(bins_words[f//4,r], f%4)==b] · w[c,r].
 
     bins_words : (Fw, S) int32 — 4 features per word, Fw a multiple of
@@ -182,6 +199,7 @@ def build_histogram_packed(bins_words: jax.Array, w: jax.Array, *,
         out_shape=jax.ShapeDtypeStruct((fw * 4, 3, b_pad), jnp.float32),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
     )(bins_words, w)
     return out[:, :, :num_bins].transpose(0, 2, 1)
 

@@ -38,8 +38,22 @@ from .mesh import make_mesh
 def apply_parallel_sharding(gbdt, mesh: Mesh, mode: str) -> None:
     """Re-place a GBDT's device arrays for a parallel mode.  Subsequent jitted
     steps compile under GSPMD with collectives over the mesh."""
+    from ..learner import TPUTreeLearner
+
     axis = mesh.axis_names[0]
+    # pipelined iterations queued before the swap hold compact-format records
+    # — materialize them with the learner that produced them
+    if hasattr(gbdt, "_flush_pending"):
+        gbdt._flush_pending()
     learner = gbdt.learner
+    if type(learner) is not TPUTreeLearner:
+        # the compact learner keeps its own packed-bin cache and global-axis
+        # sort — the sharding mutations below would be silently ignored;
+        # transparently swap in the masked learner (the same routing
+        # `create_tree_learner` applies for parallel modes)
+        learner = TPUTreeLearner(learner.cfg, learner.data,
+                                 learner.hist_backend)
+        gbdt.learner = learner
     if mode in ("data", "voting"):
         bins_spec = P(None, axis)      # (F, N): shard rows
         row_spec = P(axis)

@@ -277,6 +277,7 @@ class Tree:
             self.leaf_depth[:1] = 0
             return
         visited = set()
+        leaves_seen = set()
         stack = [(0, 0)]  # (node, depth)
         while stack:
             node, depth = stack.pop()
@@ -287,10 +288,23 @@ class Tree:
             for child in (self.left_child[node], self.right_child[node]):
                 if child < 0:
                     leaf = ~child
+                    if leaf >= self.num_leaves or leaf in leaves_seen:
+                        raise ValueError("malformed tree: leaf index out of "
+                                         "range or reached twice")
+                    leaves_seen.add(leaf)
                     self.leaf_depth[leaf] = depth + 1
                     self.leaf_parent[leaf] = node
                 else:
                     stack.append((int(child), depth + 1))
+        # every internal node and every leaf must have been reached — an
+        # unreachable node would leave leaf_depth at 0 and silently truncate
+        # the device traversal scan (sized by leaf_depth.max())
+        if len(visited) != self.num_leaves - 1 or \
+                len(leaves_seen) != self.num_leaves:
+            raise ValueError(
+                f"malformed tree: walked {len(visited)} internal nodes / "
+                f"{len(leaves_seen)} leaves, expected "
+                f"{self.num_leaves - 1} / {self.num_leaves}")
 
     # -- packed arrays for the device batch predictor ------------------------
 

@@ -1,0 +1,79 @@
+"""Instrument the non-tree-learner parts of one boosting iteration."""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    rows = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
+    import jax
+    import jax.numpy as jnp
+
+    import lightgbm_tpu as lgb
+
+    rng = np.random.RandomState(7)
+    f = 28
+    X = rng.randn(rows, f).astype(np.float64)
+    logit = (X[:, 0] * 1.5 + X[:, 1] * X[:, 2] * 0.5 + np.sin(X[:, 3])
+             + 0.5 * rng.randn(rows))
+    y = (logit > 0).astype(np.float64)
+    params = {"objective": "binary", "num_leaves": 255, "max_bin": 255,
+              "learning_rate": 0.1, "min_data_in_leaf": 20,
+              "verbosity": -1, "metric": "none"}
+    ds = lgb.Dataset(X, label=y, params=params)
+    bst = lgb.Booster(params, ds)
+    for _ in range(2):
+        bst.update()
+
+    g = bst.gbdt
+    lrn = g.learner
+
+    def t(label, fn, n=5, sync=True):
+        r = fn()
+        if sync:
+            jax.block_until_ready(r) if r is not None else None
+        t0 = time.perf_counter()
+        for _ in range(n):
+            r = fn()
+            if sync and r is not None:
+                jax.block_until_ready(r)
+        dt = (time.perf_counter() - t0) / n
+        print(f"{label:40s} {dt*1e3:9.2f} ms")
+        return r
+
+    print("=== boost-step pieces ===")
+    t("compute_gradients", lambda: g._compute_gradients())
+    grad, hess = g._compute_gradients()
+    jax.block_until_ready((grad, hess))
+    t("feature_sample", lambda: g._feature_sample())
+    fmask = g._feature_sample()
+
+    t("jit_tree (device only)",
+      lambda: lrn._jit_tree_c(grad[0], hess[0], g._bag_mask, fmask))
+    rec_f, rec_i, leaf_id = lrn._jit_tree_c(grad[0], hess[0], g._bag_mask,
+                                            fmask)
+    jax.block_until_ready((rec_f, rec_i, leaf_id))
+    t("rec fetch (np.asarray x2)",
+      lambda: (np.asarray(rec_f), np.asarray(rec_i), None)[2], sync=False)
+    rf, ri = np.asarray(rec_f), np.asarray(rec_i)
+    t("assemble (python tree build)",
+      lambda: (lrn._assemble_compact(rf, ri), None)[1], sync=False)
+    tree = lrn._assemble_compact(rf, ri)
+
+    t("score_np sync (renew prep)",
+      lambda: (np.asarray(g.train_score.score[0]), None)[1], sync=False)
+    t("renew_tree_output", lambda: g.objective.renew_tree_output(
+        tree, np.asarray(g.train_score.score[0])[:g.num_data], leaf_id,
+        g._np_bag_mask), sync=False)
+    t("add_by_leaf_id", lambda: g.train_score.add_by_leaf_id(
+        tree.leaf_value[:tree.num_leaves], leaf_id, 0))
+    t("full update()", lambda: bst.update(), n=3, sync=False)
+
+
+if __name__ == "__main__":
+    main()

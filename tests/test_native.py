@@ -1,0 +1,63 @@
+"""Native C++ parser fast path vs the numpy fallback."""
+
+import numpy as np
+import pytest
+
+
+def _numpy_rows(lines, delim):
+    if delim == " ":
+        tok_rows = (ln.split() for ln in lines)
+    else:
+        tok_rows = (ln.rstrip(delim).split(delim) for ln in lines)
+    return np.asarray([np.fromiter(
+        (float(x) if x.strip() else np.nan for x in toks),
+        dtype=np.float64) for toks in tok_rows])
+
+
+@pytest.fixture(scope="module")
+def native():
+    try:
+        from lightgbm_tpu import native as nat
+        nat.parse_dense.__doc__  # force load via first call below
+        return nat
+    except ImportError:
+        pytest.skip("no C++ toolchain for the native parser")
+
+
+@pytest.mark.parametrize("delim", [",", "\t", " "])
+def test_parse_dense_matches_numpy(tmp_path, native, delim, rng):
+    rows = rng.randn(50, 7).round(4)
+    sep = delim if delim != " " else "  "  # double spaces must collapse
+    path = tmp_path / "data.txt"
+    path.write_text("\n".join(sep.join(f"{v:g}" for v in r) for r in rows)
+                    + "\n")
+    got = native.parse_dense(str(path), delim)
+    lines = [ln for ln in path.read_text().splitlines() if ln.strip()]
+    np.testing.assert_allclose(got, _numpy_rows(lines, delim))
+    np.testing.assert_allclose(got, rows)
+
+
+def test_parse_dense_missing_and_trailing(tmp_path, native):
+    path = tmp_path / "m.csv"
+    path.write_text("1.5,,2.0,\n,3.0,4.0,\n")
+    got = native.parse_dense(str(path), ",")
+    want = np.array([[1.5, np.nan, 2.0], [np.nan, 3.0, 4.0]])
+    np.testing.assert_allclose(got, want)
+
+
+def test_parse_dense_skip_rows_and_crlf(tmp_path, native):
+    path = tmp_path / "h.tsv"
+    path.write_text("a\tb\tc\r\n1\t2\t3\r\n4\t5\t6\r\n")
+    got = native.parse_dense(str(path), "\t", skip_rows=1)
+    np.testing.assert_allclose(got, [[1, 2, 3], [4, 5, 6]])
+
+
+def test_loader_uses_native_when_available(tmp_path, native, rng):
+    from lightgbm_tpu.io.parser import load_data_file
+    rows = np.column_stack([rng.randint(0, 2, 20).astype(float),
+                            rng.randn(20, 3).round(3)])
+    path = tmp_path / "train.csv"
+    path.write_text("\n".join(",".join(f"{v:g}" for v in r) for r in rows))
+    mat, label, weight, group = load_data_file(str(path))
+    np.testing.assert_allclose(label, rows[:, 0])
+    np.testing.assert_allclose(mat, rows[:, 1:])

@@ -176,3 +176,54 @@ def test_fix_histogram_reconstructs_default_bin(rng):
         # non-default bins untouched
         mask = np.arange(b) != d
         np.testing.assert_allclose(fixed[fi, mask], hist[fi, mask])
+
+
+# -- Pallas packed-word kernel (interpret mode: runs the kernel's own code
+# path on CPU, the on-TPU compact learner's default histogram) --------------
+
+def _packed_setup(rng, f, n, b):
+    from lightgbm_tpu.ops.hist_pallas import pack_bin_words
+    bins = rng.randint(0, b, size=(f, n)).astype(np.uint8)
+    w = rng.randn(3, n).astype(np.float32)
+    words = np.asarray(pack_bin_words(jnp.asarray(bins)))
+    return bins, w, words
+
+
+def test_histogram_packed_interpret_matches_onehot(rng):
+    from lightgbm_tpu.ops.hist_pallas import build_histogram_packed
+    f, n, b = 8, 2048, 64
+    bins, w, words = _packed_setup(rng, f, n, b)
+    got = np.asarray(build_histogram_packed(
+        jnp.asarray(words), jnp.asarray(w), num_bins=b, interpret=True))
+    want = np.asarray(build_histogram_onehot(
+        jnp.asarray(bins), jnp.asarray(w), num_bins=b, row_block=512))
+    # bf16 hi+lo terms carry ~16 weight mantissa bits — not full f32
+    np.testing.assert_allclose(got, want, rtol=3e-4, atol=3e-3)
+
+
+def test_histogram_packed_interpret_highest_precision(rng):
+    """nterms=0 (tpu_hist_precision=highest) must match the f32 XLA path
+    to f32 round-off."""
+    from lightgbm_tpu.ops.hist_pallas import build_histogram_packed
+    f, n, b = 4, 1024, 32
+    bins, w, words = _packed_setup(rng, f, n, b)
+    got = np.asarray(build_histogram_packed(
+        jnp.asarray(words), jnp.asarray(w), num_bins=b, nterms=0,
+        interpret=True))
+    want = _np_hist(bins, w, b)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-4)
+
+
+def test_histogram_packed_nterms3_tighter_than_nterms1(rng):
+    from lightgbm_tpu.ops.hist_pallas import build_histogram_packed
+    f, n, b = 4, 1024, 32
+    bins, w, words = _packed_setup(rng, f, n, b)
+    want = _np_hist(bins, w, b)
+    errs = {}
+    for nt in (1, 3):
+        got = np.asarray(build_histogram_packed(
+            jnp.asarray(words), jnp.asarray(w), num_bins=b, nterms=nt,
+            interpret=True))
+        errs[nt] = np.abs(got - want).max()
+    assert errs[3] <= errs[1]
+    assert errs[3] < 1e-3
