@@ -90,7 +90,10 @@ def rebind_tree_to_dataset(tree: Tree, data: _ConstructedDataset) -> None:
     → bin via the mapper's upper bounds)."""
     if not getattr(tree, "needs_rebind", False):
         return
+    from ..tree import _in_bitset
+
     real2inner = {int(j): k for k, j in enumerate(data.used_feature_map)}
+    tree._cat_bitsets_inner = {}
     for nd in range(tree.num_leaves - 1):
         real = int(tree.split_feature[nd])
         inner = real2inner.get(real)
@@ -102,6 +105,18 @@ def rebind_tree_to_dataset(tree: Tree, data: _ConstructedDataset) -> None:
         if not (tree.decision_type[nd] & 1):  # numerical
             tree.threshold_in_bin[nd] = data.bin_mappers[inner].value_to_bin(
                 float(tree.threshold[nd]))
+        else:
+            # categorical: rebuild the inner (bin-space) bitset from the
+            # stored category-value bitset via the mapper
+            cat_idx = int(tree.threshold[nd])
+            tree.threshold_in_bin[nd] = cat_idx
+            lo, hi = tree.cat_boundaries[cat_idx], \
+                tree.cat_boundaries[cat_idx + 1]
+            mapper = data.bin_mappers[inner]
+            bins = {mapper.categorical_2_bin[c]
+                    for c in mapper.categorical_2_bin
+                    if c >= 0 and _in_bitset(tree.cat_threshold, lo, hi, c)}
+            tree._cat_bitsets_inner[cat_idx] = bins
     # the cached traversal pack (if any) was built from the previous bin
     # space — the bin-space transition owns its invalidation
     if hasattr(tree, "_traverse_pack"):
@@ -140,23 +155,33 @@ def _traverse_tree_binned(data: _ConstructedDataset, tree: Tree) -> jax.Array:
         num_bin, missing, default_bin, _ = data.feature_meta_arrays()
         feat = tree.split_feature_inner[:ni]
         depth = int(tree.leaf_depth[:tree.num_leaves].max())
+        w = (int(data.max_num_bin) + 31) // 32
+        is_cat_n = (tree.decision_type[:ni] & 1) != 0
+        cat_bits = np.zeros((ni, w), dtype=np.uint32)
+        if is_cat_n.any():
+            inner_sets = getattr(tree, "_cat_bitsets_inner", {})
+            for nd in np.where(is_cat_n)[0]:
+                for b in inner_sets.get(int(tree.threshold_in_bin[nd]), ()):
+                    cat_bits[nd, b // 32] |= np.uint32(1 << (b % 32))
         pack = (depth,
                 jnp.asarray(feat), jnp.asarray(tree.threshold_in_bin[:ni]),
                 jnp.asarray(missing[feat]), jnp.asarray(default_bin[feat]),
                 jnp.asarray(num_bin[feat] - 1),
                 jnp.asarray((tree.decision_type[:ni] & 2) != 0),
                 jnp.asarray(tree.left_child[:ni]),
-                jnp.asarray(tree.right_child[:ni]))
+                jnp.asarray(tree.right_child[:ni]),
+                jnp.asarray(is_cat_n), jnp.asarray(cat_bits))
         packs[1][key] = (weakref.ref(data), pack)
     depth, feat, thr, node_missing, node_default_bin, node_nan_bin, \
-        node_default_left, left_child, right_child = pack
+        node_default_left, left_child, right_child, node_is_cat, \
+        node_cat_bits = pack
     # leaf values change under DART re-shrinkage, so always ship them fresh
     leaf_value = jnp.asarray(tree.leaf_value[:tree.num_leaves]
                              .astype(np.float32))
     return _traverse_jit(
         data.device_bins(), feat, thr, node_missing, node_default_bin,
         node_nan_bin, node_default_left, left_child, right_child,
-        leaf_value, depth)
+        node_is_cat, node_cat_bits, leaf_value, depth)
 
 
 import functools
@@ -165,7 +190,7 @@ import functools
 @functools.partial(jax.jit, static_argnames=("depth",))
 def _traverse_jit(bins, feat, thr, node_missing, node_default_bin,
                   node_nan_bin, node_default_left, left_child, right_child,
-                  leaf_value, depth):
+                  node_is_cat, node_cat_bits, leaf_value, depth):
     n = bins.shape[1]
     node = jnp.zeros(n, dtype=jnp.int32)
     rows = jnp.arange(n)
@@ -178,6 +203,11 @@ def _traverse_jit(bins, feat, thr, node_missing, node_default_bin,
         is_missing = ((mt == 1) & (fv == node_default_bin[nd])) | \
                      ((mt == 2) & (fv == node_nan_bin[nd]))
         go_left = jnp.where(is_missing, node_default_left[nd], fv <= thr[nd])
+        # categorical nodes: bitset membership (CategoricalDecisionInner)
+        word = jnp.take_along_axis(node_cat_bits[nd], (fv >> 5)[:, None],
+                                   axis=1)[:, 0]
+        cat_left = ((word >> (fv & 31).astype(jnp.uint32)) & 1).astype(bool)
+        go_left = jnp.where(node_is_cat[nd], cat_left, go_left)
         nxt = jnp.where(go_left, left_child[nd], right_child[nd])
         return jnp.where(node < 0, node, nxt), None
 
@@ -266,8 +296,8 @@ class GBDT:
             return
         self._pending = []
         first_idx = len(self._models)
-        for idx, rec_f, rec_i, init_sc in pend:
-            tree = self.learner.assemble_host(rec_f, rec_i)
+        for idx, rec_f, rec_i, rec_cat, init_sc in pend:
+            tree = self.learner.assemble_host(rec_f, rec_i, rec_cat)
             if tree.num_leaves > 1:
                 tree.apply_shrinkage(self.shrinkage_rate)
                 if abs(init_sc) > kEpsilon:
@@ -469,11 +499,12 @@ class GBDT:
             self._lr_dev_val = self.shrinkage_rate
         for k in range(self.num_tree_per_iteration):
             fmask = self._feature_sample()
-            rec_f, rec_i, leaf_id, leaf_out = self.learner.train_async(
-                grad[k], hess[k], self._bag_mask, fmask)
+            rec_f, rec_i, rec_cat, leaf_id, leaf_out = \
+                self.learner.train_async(grad[k], hess[k], self._bag_mask,
+                                         fmask)
             self.train_score.score = _score_add_leaf(
                 self.train_score.score, leaf_out, leaf_id, self._lr_dev, k)
-            self._pending.append((len(self._models), rec_f, rec_i,
+            self._pending.append((len(self._models), rec_f, rec_i, rec_cat,
                                   init_scores[k]))
             self._models.append(None)
         self.iter_ += 1
@@ -732,6 +763,82 @@ class GBDT:
                            num_iteration: int = -1) -> None:
         with open(filename, "w") as fh:
             fh.write(self.save_model_to_string(start_iteration, num_iteration))
+
+    # -- JSON dump (`gbdt_model_text.cpp:15-60` DumpModel) -------------------
+
+    def dump_model(self, start_iteration: int = 0, num_iteration: int = -1
+                   ) -> Dict[str, Any]:
+        """Model as a JSON-able dict, the reference ``DumpModel`` schema."""
+        k = max(self.num_tree_per_iteration, 1)
+        models = self.models
+        total_iteration = len(models) // k
+        start_iteration = min(max(start_iteration, 0), total_iteration)
+        num_used = len(models)
+        if num_iteration > 0:
+            num_used = min((start_iteration + num_iteration) * k, num_used)
+        out: Dict[str, Any] = {
+            "name": "tree",
+            "version": K_MODEL_VERSION,
+            "num_class": max(self.cfg.num_class, 1),
+            "num_tree_per_iteration": self.num_tree_per_iteration,
+            "label_index": self.label_idx,
+            "max_feature_idx": self.max_feature_idx,
+            "average_output": self.average_output,
+        }
+        if self.objective is not None:
+            out["objective"] = self.objective.to_string()
+        out["feature_names"] = list(self.feature_names)
+        out["tree_info"] = [
+            dict(tree_index=i - start_iteration * k,
+                 **models[i].to_json())
+            for i in range(start_iteration * k, num_used)]
+        return out
+
+    # -- refit (`gbdt.cpp` RefitTree + `serial_tree_learner.cpp`
+    #    FitByExistingTree) --------------------------------------------------
+
+    def refit_leaf_preds(self, leaf_preds: np.ndarray,
+                         decay_rate: float = 0.9) -> None:
+        """Refit every tree's leaf values on this booster's CURRENT train
+        data: per iteration, gradients at the running score, per-leaf
+        grad/hess sums, ``decay·old + (1-decay)·new·shrinkage``."""
+        models = self.models  # flush pending
+        k = max(self.num_tree_per_iteration, 1)
+        n = self.num_data
+        assert leaf_preds.shape == (n, len(models)), \
+            (leaf_preds.shape, n, len(models))
+        from ..ops.split import calculate_leaf_output
+        cfg = self.cfg
+        # zero the running score — refit replays boosting from scratch
+        self.train_score.score = jnp.zeros_like(self.train_score.score)
+        for it in range(len(models) // k):
+            grad, hess = self._compute_gradients()
+            g_np = np.asarray(grad)[:, :n]
+            h_np = np.asarray(hess)[:, :n]
+            for tid in range(k):
+                mi = it * k + tid
+                tree = models[mi]
+                lp = leaf_preds[:, mi].astype(np.int64)
+                nl = tree.num_leaves
+                sum_g = np.bincount(lp, weights=g_np[tid], minlength=nl)
+                sum_h = np.bincount(lp, weights=h_np[tid],
+                                    minlength=nl) + kEpsilon
+                new_out = np.asarray(calculate_leaf_output(
+                    jnp.asarray(sum_g), jnp.asarray(sum_h),
+                    float(cfg.lambda_l1), float(cfg.lambda_l2),
+                    float(cfg.max_delta_step)))
+                old = tree.leaf_value[:nl]
+                tree.leaf_value[:nl] = (decay_rate * old
+                                        + (1.0 - decay_rate)
+                                        * new_out * tree.shrinkage)
+                # AddScore with the new leaf values over the refit data
+                lv = jnp.asarray(tree.leaf_value[:nl].astype(np.float32))
+                pad = self.train_data.num_data_padded - n
+                lp_pad = jnp.asarray(np.pad(lp, (0, pad)))
+                self.train_score.score = self.train_score.score.at[tid].add(
+                    jnp.where(jnp.arange(len(lp_pad)) < n, lv[lp_pad], 0.0))
+                if hasattr(tree, "_traverse_pack"):
+                    del tree._traverse_pack
 
     def load_model_from_string(self, s: str) -> "GBDT":
         """`gbdt_model_text.cpp:343-440`."""

@@ -1,0 +1,160 @@
+"""Command-line application: ``python -m lightgbm_tpu config=train.conf``.
+
+The analogue of the reference CLI (`src/main.cpp`,
+`src/application/application.cpp:30-260`): ``key=value`` arguments, a
+``config=`` file (same ``Config::KV2Map`` syntax — `src/io/config.cpp:15-43`),
+and the four tasks
+
+  * ``task=train``          — train, write ``output_model``
+  * ``task=predict``        — score ``data`` with ``input_model``, write
+                              ``output_result``
+  * ``task=refit``          — refit an existing model's leaf values on new
+                              data (`gbdt.cpp` RefitTree)
+  * ``task=convert_model``  — model text → C++ if-else source
+                              (`gbdt_model_text.cpp` SaveModelToIfElse)
+
+Run the reference's own ``examples/*/train.conf`` unmodified from the
+example's directory.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .config import Config, parse_config_file, resolve_aliases
+
+
+def _load_params(argv: List[str]) -> Dict[str, str]:
+    """`Application::LoadParameters` (`application.cpp:48-81`): command line
+    first, then the config file (command line wins)."""
+    cmdline: Dict[str, str] = {}
+    for tok in argv:
+        if "=" not in tok:
+            continue
+        k, v = tok.split("=", 1)
+        cmdline[k.strip()] = v.strip().strip('"').strip("'")
+    cmdline = resolve_aliases(cmdline)
+    params: Dict[str, str] = {}
+    if "config" in cmdline:
+        params.update(parse_config_file(cmdline.pop("config")))
+        params = resolve_aliases(params)
+    params.update(cmdline)
+    return params
+
+
+def _log(msg: str) -> None:
+    print(f"[LightGBM-TPU] [Info] {msg}")
+
+
+def run_train(params: Dict[str, str], cfg: Config) -> None:
+    from . import engine
+    from .dataset import Dataset
+
+    t0 = time.time()
+    train_set = Dataset(cfg.data, params=dict(params))
+    valid_sets = []
+    valid_names = []
+    for i, v in enumerate(cfg.valid):
+        valid_sets.append(Dataset(v, reference=train_set,
+                                  params=dict(params)))
+        valid_names.append(f"valid_{i + 1}")
+    _log(f"Finished loading parameters")
+    booster = engine.train(
+        dict(params), train_set, cfg.num_iterations,
+        valid_sets=valid_sets, valid_names=valid_names,
+        init_model=cfg.input_model or None,
+        early_stopping_rounds=(cfg.early_stopping_round
+                               if cfg.early_stopping_round > 0 else None),
+        verbose_eval=max(cfg.metric_freq, 1),
+        keep_training_booster=True)
+    booster.save_model(cfg.output_model)
+    if cfg.convert_model_language == "cpp":
+        _save_if_else(booster, cfg.convert_model)
+    _log(f"Finished training in {time.time() - t0:.6f} seconds")
+
+
+def run_predict(params: Dict[str, str], cfg: Config) -> None:
+    from .engine import Booster
+    from .dataset import Dataset
+    from .io.parser import load_data_file
+
+    if not cfg.input_model:
+        raise ValueError("task=predict requires input_model")
+    booster = Booster(model_file=cfg.input_model, params=dict(params))
+    mat, _, _, _ = load_data_file(cfg.data, dict(params))
+    # data files carry the label in column label_idx; drop it like the
+    # loader does for training (Predictor::Predict parses full rows)
+    kwargs = {}
+    if cfg.num_iteration_predict > 0:
+        kwargs["num_iteration"] = cfg.num_iteration_predict
+    if cfg.predict_leaf_index:
+        out = booster.predict(mat, pred_leaf=True, **kwargs)
+    elif cfg.predict_contrib:
+        out = booster.predict(mat, pred_contrib=True, **kwargs)
+    elif cfg.predict_raw_score:
+        out = booster.predict(mat, raw_score=True, **kwargs)
+    else:
+        out = booster.predict(mat, **kwargs)
+    out = np.atleast_2d(np.asarray(out))
+    if out.shape[0] == 1 and out.size > 1:
+        out = out.T
+    with open(cfg.output_result, "w") as fh:
+        for row in out:
+            fh.write("\t".join(f"{v:g}" for v in np.atleast_1d(row)) + "\n")
+    _log("Finished prediction")
+
+
+def run_refit(params: Dict[str, str], cfg: Config) -> None:
+    from .engine import Booster
+
+    if not cfg.input_model:
+        raise ValueError("task=refit requires input_model")
+    booster = Booster(model_file=cfg.input_model, params=dict(params))
+    booster.refit_file(cfg.data, decay_rate=cfg.refit_decay_rate)
+    booster.save_model(cfg.output_model)
+    _log("Finished RefitTree")
+
+
+def _save_if_else(booster, path: str) -> None:
+    from .convert import model_to_if_else
+
+    with open(path or "gbdt_prediction.cpp", "w") as fh:
+        fh.write(model_to_if_else(booster.gbdt))
+    _log("Finished converting model to if-else statements")
+
+
+def run_convert_model(params: Dict[str, str], cfg: Config) -> None:
+    from .engine import Booster
+
+    if not cfg.input_model:
+        raise ValueError("task=convert_model requires input_model")
+    booster = Booster(model_file=cfg.input_model, params=dict(params))
+    _save_if_else(booster, cfg.convert_model)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    params = _load_params(argv)
+    cfg = Config.from_params(params)
+    if not cfg.data and cfg.task != "convert_model":
+        print("[LightGBM-TPU] [Fatal] No training/prediction data, "
+              "application quit", file=sys.stderr)
+        return 1
+    task = {"train": run_train, "refit_tree": run_refit, "refit": run_refit,
+            "predict": run_predict, "prediction": run_predict,
+            "test": run_predict, "convert_model": run_convert_model
+            }.get(cfg.task)
+    if task is None:
+        print(f"[LightGBM-TPU] [Fatal] Unknown task: {cfg.task}",
+              file=sys.stderr)
+        return 1
+    task(params, cfg)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

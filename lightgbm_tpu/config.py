@@ -386,6 +386,12 @@ class Config:
         if self.bagging_fraction < 1.0 and self.bagging_freq == 0:
             # bagging only active when bagging_freq > 0 (`gbdt.cpp:689` semantics)
             pass
+        # loudly reject parameters that parse but are not implemented yet —
+        # silently training a different model than the reference is worse
+        # than failing
+        if self.forcedsplits_filename:
+            warnings.warn("forcedsplits_filename is not implemented in "
+                          "lightgbm_tpu yet; the parameter is IGNORED")
 
     def to_dict(self) -> Dict[str, Any]:
         d = dataclasses.asdict(self)

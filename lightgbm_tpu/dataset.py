@@ -86,6 +86,28 @@ class Metadata:
     def num_queries(self) -> int:
         return 0 if self.query_boundaries is None else len(self.query_boundaries) - 1
 
+    def subset(self, idx: np.ndarray) -> "Metadata":
+        """Row-subset (`metadata.cpp` Init(metadata, used_indices)); query
+        boundaries are rebuilt only when the subset keeps whole queries in
+        order."""
+        out = Metadata(len(idx))
+        out.label = self.label[idx]
+        if self.weights is not None:
+            out.weights = self.weights[idx]
+        if self.init_score is not None:
+            k = len(self.init_score) // max(self.num_data, 1)
+            out.init_score = self.init_score.reshape(
+                k, self.num_data)[:, idx].reshape(-1)
+        if self.query_boundaries is not None:
+            qid = np.searchsorted(self.query_boundaries, idx, "right") - 1
+            if (np.diff(qid) >= 0).all():
+                _, sizes = np.unique(qid, return_counts=True)
+                out.set_group(sizes)
+            else:
+                raise ValueError("subset of a ranking dataset must keep "
+                                 "query groups contiguous")
+        return out
+
 
 class Dataset:
     """User-facing dataset (mirrors `python-package/lightgbm/basic.py:655-1575`
@@ -114,6 +136,21 @@ class Dataset:
     def construct(self) -> "Dataset":
         if self._constructed is None:
             cfg = Config.from_params(self.params)
+            if isinstance(self._raw_data, str) and \
+                    _ConstructedDataset.is_binary_file(self._raw_data):
+                self._constructed = _ConstructedDataset.load_binary(
+                    self._raw_data, cfg)
+                # user-supplied fields override the cached metadata, same as
+                # the raw-data path below
+                if self._label is not None:
+                    self._constructed.metadata.set_label(self._label)
+                if self._weight is not None:
+                    self._constructed.metadata.set_weights(self._weight)
+                if self._group is not None:
+                    self._constructed.metadata.set_group(self._group)
+                if self._init_score is not None:
+                    self._constructed.metadata.set_init_score(self._init_score)
+                return self
             data = self._load_raw(self._raw_data)
             if self.reference is not None:
                 ref = self.reference.construct()._constructed
@@ -163,8 +200,17 @@ class Dataset:
 
     def _resolve_categorical(self, data) -> List[int]:
         cf = self.categorical_feature
-        if cf == "auto" or cf is None:
-            return []
+        if cf == "auto" or cf is None or cf == "":
+            # fall back to the config parameter (`categorical_feature=0,1,2`
+            # or `name:c1,c2` — `config.h:438-446` / `config.cpp` parsing)
+            cf = Config.from_params(self.params).categorical_feature
+            if not cf:
+                return []
+        if isinstance(cf, str):
+            if cf.startswith("name:"):
+                cf = [c.strip() for c in cf[5:].split(",") if c.strip()]
+            else:
+                cf = [int(c) for c in cf.split(",") if c.strip()]
         names = self._resolve_feature_names(data)
         out = []
         for c in cf:
@@ -234,6 +280,78 @@ class Dataset:
     @property
     def constructed(self) -> "_ConstructedDataset":
         return self.construct()._constructed
+
+    # -- binary cache (`basic.py:1078` save_binary /
+    #    `dataset_loader.cpp:266` LoadFromBinFile).  The format is our own
+    #    (npz of bins + mappers + metadata) — binning once and reloading the
+    #    cache skips the whole find-bin/bin-all pass. -----------------------
+
+    def save_binary(self, filename: str) -> "Dataset":
+        self.construct()._constructed.save_binary(filename)
+        return self
+
+    @classmethod
+    def _from_constructed(cls, constructed: "_ConstructedDataset",
+                          params: Optional[Dict] = None) -> "Dataset":
+        ds = cls(None, params=params)
+        ds._constructed = constructed
+        return ds
+
+    # -- subset / feature concat (`basic.py:1053` subset,
+    #    `basic.py:1121` add_features_from) --------------------------------
+
+    def subset(self, used_indices, params: Optional[Dict] = None) -> "Dataset":
+        """Row-subset sharing this dataset's bin mappers (no re-binning)."""
+        con = self.construct()._constructed
+        idx = np.asarray(used_indices, dtype=np.int64)
+        sub = _ConstructedDataset()
+        sub.num_data = len(idx)
+        sub.num_total_features = con.num_total_features
+        sub.feature_names = con.feature_names
+        sub.config = con.config
+        sub.bin_mappers = con.bin_mappers
+        sub.used_feature_map = con.used_feature_map
+        n_pad = _round_up(max(len(idx), 1), max(
+            int(con.config.tpu_row_block), 128))
+        sub.num_data_padded = n_pad
+        sub.max_num_bin = con.max_num_bin
+        sub.bins = np.zeros((con.bins.shape[0], n_pad), dtype=con.bins.dtype)
+        sub.bins[:, :len(idx)] = con.bins[:, :con.num_data][:, idx]
+        sub.metadata = con.metadata.subset(idx)
+        out = Dataset._from_constructed(sub, params or self.params)
+        out.used_indices = idx
+        out.reference = self
+        return out
+
+    def add_features_from(self, other: "Dataset") -> "Dataset":
+        """Concatenate ``other``'s features onto this dataset in place."""
+        a = self.construct()._constructed
+        b = other.construct()._constructed
+        if a.num_data != b.num_data:
+            raise ValueError("add_features_from: datasets have different "
+                             f"row counts ({a.num_data} vs {b.num_data})")
+        fa = a.num_total_features
+        n_pad = max(a.num_data_padded, b.num_data_padded)
+        fu = a.num_used_features + b.num_used_features
+        fu_pad = _round_up(max(fu, 1), _ConstructedDataset.FEATURE_TILE)
+        dtype = np.uint8 if max(a.max_num_bin, b.max_num_bin) <= 256 \
+            else np.uint16
+        bins = np.zeros((fu_pad, n_pad), dtype=dtype)
+        bins[:a.num_used_features, :a.num_data] = \
+            a.bins[:a.num_used_features, :a.num_data]
+        bins[a.num_used_features:fu, :b.num_data] = \
+            b.bins[:b.num_used_features, :b.num_data]
+        a.bins = bins
+        a.num_data_padded = n_pad
+        a.bin_mappers = list(a.bin_mappers) + list(b.bin_mappers)
+        a.used_feature_map = np.concatenate(
+            [a.used_feature_map, b.used_feature_map + fa]).astype(np.int32)
+        a.num_total_features = fa + b.num_total_features
+        a.feature_names = list(a.feature_names) + list(b.feature_names)
+        a.max_num_bin = max(a.max_num_bin, b.max_num_bin)
+        a._device_bins = None
+        a._feature_meta = None
+        return self
 
 
 class _ConstructedDataset:
@@ -345,6 +463,78 @@ class _ConstructedDataset:
         for k, m in enumerate(self.bin_mappers):
             j = int(self.used_feature_map[k])
             self.bins[k, :n] = m.values_to_bins(mat[:, j]).astype(dtype)
+
+    # -- binary cache format -------------------------------------------------
+
+    BINARY_VERSION = 1
+
+    def save_binary(self, filename: str) -> None:
+        """Serialize the constructed (binned) dataset — reloading skips
+        find-bin + binning entirely (`dataset.h:394` SaveBinaryFile)."""
+        import json
+
+        md = self.metadata
+        with open(filename, "wb") as fh:  # np.savez appends .npz to names
+            np.savez_compressed(
+                fh,
+                lgbt_binary_version=np.int64(self.BINARY_VERSION),
+                bins=self.bins,
+                used_feature_map=self.used_feature_map,
+                num_data=np.int64(self.num_data),
+                num_total_features=np.int64(self.num_total_features),
+                max_num_bin=np.int64(self.max_num_bin),
+                feature_names=np.asarray(self.feature_names, dtype=object),
+                mappers=np.asarray(
+                    json.dumps([m.to_dict() for m in self.bin_mappers]),
+                    dtype=object),
+                label=md.label,
+                weights=(md.weights if md.weights is not None
+                         else np.zeros(0, np.float32)),
+                query_boundaries=(md.query_boundaries
+                                  if md.query_boundaries is not None
+                                  else np.zeros(0, np.int32)),
+                init_score=(md.init_score if md.init_score is not None
+                            else np.zeros(0, np.float64)))
+
+    @classmethod
+    def load_binary(cls, filename: str, cfg: Config) -> "_ConstructedDataset":
+        import json
+
+        z = np.load(filename, allow_pickle=True)
+        if int(z["lgbt_binary_version"]) > cls.BINARY_VERSION:
+            raise ValueError("binary dataset written by a newer version")
+        self = cls()
+        self.config = cfg
+        self.bins = z["bins"]
+        self.used_feature_map = z["used_feature_map"]
+        self.num_data = int(z["num_data"])
+        self.num_data_padded = self.bins.shape[1]
+        self.num_total_features = int(z["num_total_features"])
+        self.max_num_bin = int(z["max_num_bin"])
+        self.feature_names = [str(s) for s in z["feature_names"]]
+        self.bin_mappers = [BinMapper.from_dict(d)
+                            for d in json.loads(str(z["mappers"]))]
+        self.metadata = Metadata(self.num_data)
+        self.metadata.label = z["label"]
+        if len(z["weights"]):
+            self.metadata.weights = z["weights"]
+        if len(z["query_boundaries"]):
+            self.metadata.query_boundaries = z["query_boundaries"]
+        if len(z["init_score"]):
+            self.metadata.init_score = z["init_score"]
+        return self
+
+    @staticmethod
+    def is_binary_file(path: str) -> bool:
+        try:
+            with open(path, "rb") as fh:
+                magic = fh.read(4)
+            if magic[:2] != b"PK":
+                return False
+            with np.load(path, allow_pickle=True) as z:
+                return "lgbt_binary_version" in z
+        except Exception:
+            return False
 
     # -- device placement ----------------------------------------------------
 

@@ -157,6 +157,46 @@ class Booster:
             num_iteration = self.best_iteration if self.best_iteration > 0 else -1
         return self.gbdt.save_model_to_string(start_iteration, num_iteration)
 
+    def dump_model(self, num_iteration: int = -1, start_iteration: int = 0
+                   ) -> Dict:
+        """Model as a JSON-able dict (`basic.py:2102` / ``DumpModel``,
+        `gbdt_model_text.cpp:15`)."""
+        if num_iteration < 0:
+            num_iteration = self.best_iteration if self.best_iteration > 0 else -1
+        ret = self.gbdt.dump_model(start_iteration, num_iteration)
+        # the python layer appends pandas category mappings (`basic.py:2233`);
+        # None for non-pandas-categorical training data
+        ret["pandas_categorical"] = None
+        return ret
+
+    def refit(self, data, label, decay_rate: float = 0.9,
+              **kwargs) -> "Booster":
+        """Refit the existing model's leaf values on new data
+        (`basic.py:2284` Booster.refit → ``GBDT::RefitTree``,
+        `gbdt.cpp:262-286`)."""
+        leaf_preds = self.predict(data, pred_leaf=True, **kwargs)
+        leaf_preds = np.atleast_2d(np.asarray(leaf_preds))
+        new_train = Dataset(data, label=label, params=dict(self.params))
+        new_booster = Booster(params=dict(self.params), train_set=new_train)
+        import copy as _copy
+        new_booster.gbdt.models = [_copy.deepcopy(t) for t in self.gbdt.models]
+        new_booster.gbdt.iter_ = len(new_booster.gbdt.models) // max(
+            new_booster.gbdt.num_tree_per_iteration, 1)
+        for tree in new_booster.gbdt.models:
+            # inner bin-space fields refer to the OLD dataset; rebuild lazily
+            # if this booster continues training (`_continue_training`)
+            tree.needs_rebind = True
+        new_booster.gbdt.refit_leaf_preds(leaf_preds, decay_rate)
+        return new_booster
+
+    def refit_file(self, data_path: str, decay_rate: float = 0.9) -> "Booster":
+        """CLI ``task=refit``: refit in place from a data file."""
+        from .io.parser import load_data_file
+        mat, label, _, _ = load_data_file(data_path, self.params)
+        refitted = self.refit(mat, label, decay_rate)
+        self.gbdt = refitted.gbdt
+        return self
+
     def feature_importance(self, importance_type: str = "split",
                            iteration: int = -1) -> np.ndarray:
         return self.gbdt.feature_importance(importance_type, iteration)

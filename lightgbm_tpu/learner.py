@@ -42,8 +42,8 @@ from .tree import Tree
 REC_VALID, REC_LEAF, REC_FEATURE, REC_THRESHOLD, REC_DEFAULT_LEFT, REC_GAIN, \
     REC_LEFT_OUT, REC_RIGHT_OUT, REC_LEFT_CNT, REC_RIGHT_CNT, \
     REC_INTERNAL_VALUE, REC_INTERNAL_CNT, REC_LEFT_SUM_H, REC_RIGHT_SUM_H, \
-    REC_LEFT_SUM_G, REC_RIGHT_SUM_G = range(16)
-NUM_REC_FIELDS = 16
+    REC_LEFT_SUM_G, REC_RIGHT_SUM_G, REC_IS_CAT = range(17)
+NUM_REC_FIELDS = 17
 
 
 class TreeState(NamedTuple):
@@ -54,17 +54,22 @@ class TreeState(NamedTuple):
     leaf_cnt: jax.Array      # (L,) f32
     leaf_output: jax.Array   # (L,) f32
     leaf_depth: jax.Array    # (L,) int32
-    cand: SplitCandidates    # per-leaf best splits, arrays (L,)
+    cand: "_LeafCand"        # per-leaf best splits, arrays (L,)
     num_leaves: jax.Array    # () int32
     records: jax.Array       # (L-1, NUM_REC_FIELDS) f32
+    rec_cat: jax.Array       # (L-1, W) uint32 — bin bitset of cat splits
+    leaf_min_c: jax.Array    # (L,) monotone value constraints per leaf
+    leaf_max_c: jax.Array
 
 
-class _LeafCand(NamedTuple):
-    """Best split per LEAF, reduced over features (fields shape (L,))."""
+class _FeatCand(NamedTuple):
+    """Merged numerical+categorical best split PER FEATURE (fields (F,);
+    cat_bits (F, W))."""
     gain: jax.Array
-    feature: jax.Array
     threshold: jax.Array
     default_left: jax.Array
+    is_cat: jax.Array
+    cat_bits: jax.Array
     left_sum_g: jax.Array
     left_sum_h: jax.Array
     left_cnt: jax.Array
@@ -75,7 +80,26 @@ class _LeafCand(NamedTuple):
     right_output: jax.Array
 
 
-def _reduce_over_features(cand: SplitCandidates) -> _LeafCand:
+class _LeafCand(NamedTuple):
+    """Best split per LEAF, reduced over features (fields shape (L,);
+    cat_bits (L, W))."""
+    gain: jax.Array
+    feature: jax.Array
+    threshold: jax.Array
+    default_left: jax.Array
+    is_cat: jax.Array
+    cat_bits: jax.Array
+    left_sum_g: jax.Array
+    left_sum_h: jax.Array
+    left_cnt: jax.Array
+    right_sum_g: jax.Array
+    right_sum_h: jax.Array
+    right_cnt: jax.Array
+    left_output: jax.Array
+    right_output: jax.Array
+
+
+def _reduce_over_features(cand: _FeatCand) -> _LeafCand:
     """argmax over features; lowest feature index wins ties
     (`serial_tree_learner.cpp:505-520`)."""
     best_f = jnp.argmax(cand.gain).astype(jnp.int32)
@@ -83,6 +107,7 @@ def _reduce_over_features(cand: SplitCandidates) -> _LeafCand:
     return _LeafCand(gain=g(cand.gain), feature=best_f,
                      threshold=g(cand.threshold),
                      default_left=g(cand.default_left),
+                     is_cat=g(cand.is_cat), cat_bits=g(cand.cat_bits),
                      left_sum_g=g(cand.left_sum_g), left_sum_h=g(cand.left_sum_h),
                      left_cnt=g(cand.left_cnt), right_sum_g=g(cand.right_sum_g),
                      right_sum_h=g(cand.right_sum_h), right_cnt=g(cand.right_cnt),
@@ -130,9 +155,38 @@ class TPUTreeLearner:
             min_data_in_leaf=int(cfg.min_data_in_leaf),
             min_sum_hessian_in_leaf=float(cfg.min_sum_hessian_in_leaf),
             min_gain_to_split=float(cfg.min_gain_to_split))
-        # categorical features are excluded from the numerical split finder
-        # until the categorical scan lands; combined with user feature masks.
-        self._cat_mask = jnp.asarray(~is_cat)
+        self._cat_split_kwargs = dict(
+            self._split_kwargs,
+            cat_l2=float(cfg.cat_l2), cat_smooth=float(cfg.cat_smooth),
+            max_cat_threshold=int(cfg.max_cat_threshold),
+            max_cat_to_onehot=int(cfg.max_cat_to_onehot),
+            min_data_per_group=int(cfg.min_data_per_group))
+        # numerical features go to the two-scan finder, categoricals to the
+        # one-hot / sorted-CTR finder; masks combine with the per-tree
+        # feature_fraction mask
+        self._cat_mask = jnp.asarray(~is_cat)      # numerical features
+        self._is_cat_mask = jnp.asarray(is_cat)    # categorical features
+        self.has_categorical = bool(is_cat.any())
+        self.cat_W = (self.num_bins_padded + 31) // 32
+        # monotone constraints / per-feature gain penalty, mapped from real
+        # feature index to used-feature slots (`config.h:355-368`)
+        used_map = data.used_feature_map
+        mono = np.zeros(self.num_features, np.int8)
+        if cfg.monotone_constraints:
+            mc = list(cfg.monotone_constraints)
+            for k, j in enumerate(used_map):
+                if int(j) < len(mc):
+                    mono[k] = int(mc[int(j)])
+        self.has_monotone = bool(mono.any())
+        self.f_monotone = jnp.asarray(mono) if self.has_monotone else None
+        pen = np.ones(self.num_features, np.float32)
+        if cfg.feature_contri:
+            fc = list(cfg.feature_contri)
+            for k, j in enumerate(used_map):
+                if int(j) < len(fc):
+                    pen[k] = float(fc[int(j)])
+        self.has_penalty = bool((pen != 1.0).any())
+        self.f_penalty = jnp.asarray(pen) if self.has_penalty else None
         self._jit_init = jax.jit(self._init_root)
         self._jit_step = jax.jit(self._split_step, donate_argnums=(0,))
         self._jit_tree = jax.jit(self._train_tree_fused)
@@ -144,13 +198,83 @@ class TPUTreeLearner:
                             backend=self.hist_backend, dp=self.hist_dp)
         return h[:self.num_features]  # drop feature-tile padding rows
 
-    def _leaf_cand(self, hist, sum_g, sum_h, cnt, feature_mask, depth_ok) -> _LeafCand:
-        cand = find_best_splits(
+    def _feature_cands(self, hist, sum_g, sum_h, cnt, feature_mask,
+                       min_c=None, max_c=None) -> _FeatCand:
+        """Merged per-feature candidates: each feature scanned by its own
+        finder (`FeatureHistogram::FuncForNumrical/FuncForCategorical`,
+        `feature_histogram.hpp:256-270`).  min_c/max_c are this leaf's
+        monotone value constraints."""
+        f = self.num_features
+        w = self.cat_W
+        if not self.has_monotone:
+            min_c = max_c = None
+        elif min_c is None:
+            min_c = jnp.asarray(-jnp.inf, hist.dtype)
+            max_c = jnp.asarray(jnp.inf, hist.dtype)
+        num = find_best_splits(
             hist, sum_g, sum_h, cnt, self.f_num_bin, self.f_missing,
             self.f_default_bin, feature_mask & self._cat_mask,
+            self.f_monotone, min_c, max_c,
             **self._split_kwargs)
+        if self.has_penalty:
+            # `FindBestThreshold` gain penalty (`feature_histogram.hpp:81`)
+            num = num._replace(gain=jnp.where(
+                jnp.isneginf(num.gain), num.gain, num.gain * self.f_penalty))
+        if not self.has_categorical:
+            return _FeatCand(
+                gain=num.gain, threshold=num.threshold,
+                default_left=num.default_left,
+                is_cat=jnp.zeros(f, bool),
+                cat_bits=jnp.zeros((f, w), jnp.uint32),
+                left_sum_g=num.left_sum_g, left_sum_h=num.left_sum_h,
+                left_cnt=num.left_cnt, right_sum_g=num.right_sum_g,
+                right_sum_h=num.right_sum_h, right_cnt=num.right_cnt,
+                left_output=num.left_output, right_output=num.right_output)
+        from .ops.split_cat import find_best_splits_categorical
+        cat = find_best_splits_categorical(
+            hist, sum_g, sum_h, cnt, self.f_num_bin, self.f_missing,
+            feature_mask & self._is_cat_mask, min_c, max_c,
+            **self._cat_split_kwargs)
+        if self.has_penalty:
+            cat = cat._replace(gain=jnp.where(
+                jnp.isneginf(cat.gain), cat.gain, cat.gain * self.f_penalty))
+        ic = self._is_cat_mask
+        pick = lambda c, n: jnp.where(ic, c, n)
+        return _FeatCand(
+            gain=pick(cat.gain, num.gain),
+            threshold=jnp.where(ic, 0, num.threshold),
+            default_left=jnp.where(ic, False, num.default_left),
+            is_cat=ic,
+            cat_bits=jnp.where(ic[:, None], cat.bits,
+                               jnp.zeros((f, w), jnp.uint32)),
+            left_sum_g=pick(cat.left_sum_g, num.left_sum_g),
+            left_sum_h=pick(cat.left_sum_h, num.left_sum_h),
+            left_cnt=pick(cat.left_cnt, num.left_cnt),
+            right_sum_g=pick(cat.right_sum_g, num.right_sum_g),
+            right_sum_h=pick(cat.right_sum_h, num.right_sum_h),
+            right_cnt=pick(cat.right_cnt, num.right_cnt),
+            left_output=pick(cat.left_output, num.left_output),
+            right_output=pick(cat.right_output, num.right_output))
+
+    def _leaf_cand(self, hist, sum_g, sum_h, cnt, feature_mask, depth_ok,
+                   min_c=None, max_c=None) -> _LeafCand:
+        cand = self._feature_cands(hist, sum_g, sum_h, cnt, feature_mask,
+                                   min_c, max_c)
         lc = _reduce_over_features(cand)
         return lc._replace(gain=jnp.where(depth_ok, lc.gain, -jnp.inf))
+
+    def _child_constraints(self, info, pmin, pmax):
+        """Constraint propagation on split (`serial_tree_learner.cpp:765-776`):
+        children inherit the parent's range; a monotone numerical split pins
+        the shared boundary at the output midpoint."""
+        mono_t = self.f_monotone[info.feature]
+        mono_t = jnp.where(info.is_cat, 0, mono_t)
+        mid = (info.left_output + info.right_output) / 2.0
+        lmin = jnp.where(mono_t < 0, mid, pmin)
+        lmax = jnp.where(mono_t > 0, mid, pmax)
+        rmin = jnp.where(mono_t > 0, mid, pmin)
+        rmax = jnp.where(mono_t < 0, mid, pmax)
+        return lmin, lmax, rmin, rmax
 
     def _init_root(self, grad, hess, bag, feature_mask) -> TreeState:
         n = self.bins.shape[1]
@@ -185,7 +309,10 @@ class TPUTreeLearner:
             leaf_depth=jnp.zeros(L, jnp.int32),
             cand=cand_L,
             num_leaves=jnp.asarray(1, jnp.int32),
-            records=jnp.zeros((L - 1, NUM_REC_FIELDS), jnp.float32))
+            records=jnp.zeros((L - 1, NUM_REC_FIELDS), jnp.float32),
+            rec_cat=jnp.zeros((L - 1, self.cat_W), jnp.uint32),
+            leaf_min_c=jnp.full(L, -jnp.inf, jnp.float32),
+            leaf_max_c=jnp.full(L, jnp.inf, jnp.float32))
 
     def _split_step(self, state: TreeState, grad, hess, bag, feature_mask,
                     step_idx) -> TreeState:
@@ -200,7 +327,7 @@ class TPUTreeLearner:
         new_leaf = state.num_leaves
 
         # ---- partition rows (`data_partition.hpp` Split → `tree.h:233-249`
-        # NumericalDecisionInner)
+        # NumericalDecisionInner / `tree.h:270-277` CategoricalDecisionInner)
         frow = self.bins[info.feature]                      # (N,) bin codes
         frow = frow.astype(jnp.int32)
         mt = self.f_missing[info.feature]
@@ -210,6 +337,10 @@ class TPUTreeLearner:
                      ((mt == MISSING_NAN) & (frow == nb - 1))
         go_left = jnp.where(is_missing, info.default_left,
                             frow <= info.threshold)
+        if self.has_categorical:
+            cat_left = (info.cat_bits[frow >> 5]
+                        >> (frow & 31).astype(jnp.uint32)) & 1
+            go_left = jnp.where(info.is_cat, cat_left.astype(bool), go_left)
         at_leaf = state.leaf_id == best_leaf
         leaf_id = jnp.where(do & at_leaf & ~go_left, new_leaf, state.leaf_id)
 
@@ -243,14 +374,25 @@ class TPUTreeLearner:
         child_depth = state.leaf_depth[best_leaf] + 1
         leaf_depth = upd(state.leaf_depth, child_depth, child_depth)
 
-        # ---- children's best splits
+        # ---- children's best splits (with monotone constraint propagation)
         md = int(cfg.max_depth)
         depth_ok = jnp.asarray(True) if md <= 0 else (child_depth < md)
+        if self.has_monotone:
+            pmin = state.leaf_min_c[best_leaf]
+            pmax = state.leaf_max_c[best_leaf]
+            lmin, lmax, rmin, rmax = self._child_constraints(info, pmin, pmax)
+            leaf_min_c = upd(state.leaf_min_c, lmin, rmin)
+            leaf_max_c = upd(state.leaf_max_c, lmax, rmax)
+        else:
+            lmin = lmax = rmin = rmax = None
+            leaf_min_c = state.leaf_min_c
+            leaf_max_c = state.leaf_max_c
         cand_left = self._leaf_cand(hist_left, info.left_sum_g, info.left_sum_h,
-                                    info.left_cnt, feature_mask, depth_ok)
+                                    info.left_cnt, feature_mask, depth_ok,
+                                    lmin, lmax)
         cand_right = self._leaf_cand(hist_right, info.right_sum_g,
                                      info.right_sum_h, info.right_cnt,
-                                     feature_mask, depth_ok)
+                                     feature_mask, depth_ok, rmin, rmax)
 
         def upd_cand(arr, l_val, r_val):
             return (arr.at[best_leaf].set(
@@ -279,14 +421,17 @@ class TPUTreeLearner:
         rec = rec.at[REC_RIGHT_SUM_H].set(info.right_sum_h)
         rec = rec.at[REC_LEFT_SUM_G].set(info.left_sum_g)
         rec = rec.at[REC_RIGHT_SUM_G].set(info.right_sum_g)
+        rec = rec.at[REC_IS_CAT].set(info.is_cat.astype(jnp.float32))
         records = state.records.at[step_idx].set(rec)
+        rec_cat = state.rec_cat.at[step_idx].set(info.cat_bits)
 
         return TreeState(
             leaf_id=leaf_id, hist_pool=hist_pool, leaf_sum_g=leaf_sum_g,
             leaf_sum_h=leaf_sum_h, leaf_cnt=leaf_cnt, leaf_output=leaf_output,
             leaf_depth=leaf_depth, cand=new_cand,
             num_leaves=state.num_leaves + do.astype(jnp.int32),
-            records=records)
+            records=records, rec_cat=rec_cat,
+            leaf_min_c=leaf_min_c, leaf_max_c=leaf_max_c)
 
     def _train_tree_fused(self, grad, hess, bag, feature_mask) -> TreeState:
         """The whole leaf-wise growth loop as ONE XLA computation — the
@@ -305,15 +450,17 @@ class TPUTreeLearner:
     def train_async(self, grad: jax.Array, hess: jax.Array, bag: jax.Array,
                     feature_mask: Optional[jax.Array] = None):
         """Dispatch one tree build; returns device arrays with NO host sync:
-        (rec_f, rec_i, leaf_id, leaf_output).  rec_i is None for the masked
-        learner (counts live in the f32 record)."""
+        (rec_f, rec_i, rec_cat, leaf_id, leaf_output).  rec_i is None for
+        the masked learner (counts live in the f32 record)."""
         if feature_mask is None:
             feature_mask = jnp.ones(self.num_features, dtype=bool)
         state = self._jit_tree(grad, hess, bag, feature_mask)
-        return state.records, None, state.leaf_id, state.leaf_output
+        return (state.records, None, state.rec_cat, state.leaf_id,
+                state.leaf_output)
 
-    def assemble_host(self, rec_f, rec_i) -> Tree:
-        return self._assemble(np.asarray(rec_f))
+    def assemble_host(self, rec_f, rec_i, rec_cat=None) -> Tree:
+        return self._assemble(np.asarray(rec_f),
+                              None if rec_cat is None else np.asarray(rec_cat))
 
     def train(self, grad: jax.Array, hess: jax.Array, bag: jax.Array,
               feature_mask: Optional[jax.Array] = None, fused: bool = True
@@ -331,30 +478,53 @@ class TPUTreeLearner:
                 state = self._jit_step(state, grad, hess, bag, feature_mask,
                                        jnp.asarray(i, jnp.int32))
         records = np.asarray(state.records)  # single host sync per tree
-        tree = self._assemble(records)
+        tree = self._assemble(records, np.asarray(state.rec_cat))
         return tree, state.leaf_id
 
-    def _assemble(self, records: np.ndarray) -> Tree:
-        tree = Tree(self.num_leaves)
+    def _split_host_tree(self, tree: Tree, r: np.ndarray,
+                         cat_bits: Optional[np.ndarray], left_cnt: int,
+                         right_cnt: int) -> None:
+        """Apply one recorded split to the host tree — numerical via
+        ``Tree.split``, categorical via ``Tree.split_categorical`` with the
+        bin bitset converted to category values
+        (`serial_tree_learner.cpp:727-748`)."""
+        fi = int(r[REC_FEATURE])
+        mapper = self.data.bin_mappers[fi]
         used_map = self.data.used_feature_map
+        common = dict(
+            leaf=int(r[REC_LEAF]), feature_inner=fi,
+            real_feature=int(used_map[fi]),
+            left_value=float(r[REC_LEFT_OUT]),
+            right_value=float(r[REC_RIGHT_OUT]),
+            left_cnt=left_cnt, right_cnt=right_cnt,
+            gain=float(r[REC_GAIN]),
+            missing_type=int(self.np_missing[fi]))
+        if r[REC_IS_CAT] > 0.5:
+            bits = cat_bits
+            bins = [bi for bi in range(int(self.np_num_bin[fi]))
+                    if (int(bits[bi // 32]) >> (bi % 32)) & 1]
+            cats = [int(mapper.bin_2_categorical[bi]) for bi in bins
+                    if bi < len(mapper.bin_2_categorical)
+                    and int(mapper.bin_2_categorical[bi]) >= 0]
+            tree.split_categorical(threshold_bins=bins, threshold_cats=cats,
+                                   **common)
+        else:
+            thr_bin = int(r[REC_THRESHOLD])
+            tree.split(threshold_bin=thr_bin,
+                       threshold_double=mapper.bin_to_value(thr_bin),
+                       default_left=bool(r[REC_DEFAULT_LEFT] > 0.5),
+                       **common)
+        tree.internal_value[tree.num_leaves - 2] = float(r[REC_INTERNAL_VALUE])
+
+    def _assemble(self, records: np.ndarray,
+                  rec_cat: Optional[np.ndarray] = None) -> Tree:
+        tree = Tree(self.num_leaves)
         for i in range(records.shape[0]):
             r = records[i]
             if r[REC_VALID] < 0.5:
                 break
-            fi = int(r[REC_FEATURE])
-            thr_bin = int(r[REC_THRESHOLD])
-            mapper = self.data.bin_mappers[fi]
-            tree.split(
-                leaf=int(r[REC_LEAF]), feature_inner=fi,
-                real_feature=int(used_map[fi]),
-                threshold_bin=thr_bin,
-                threshold_double=mapper.bin_to_value(thr_bin),
-                left_value=float(r[REC_LEFT_OUT]),
-                right_value=float(r[REC_RIGHT_OUT]),
+            self._split_host_tree(
+                tree, r, None if rec_cat is None else rec_cat[i],
                 left_cnt=int(round(float(r[REC_LEFT_CNT]))),
-                right_cnt=int(round(float(r[REC_RIGHT_CNT]))),
-                gain=float(r[REC_GAIN]),
-                missing_type=int(self.np_missing[fi]),
-                default_left=bool(r[REC_DEFAULT_LEFT] > 0.5))
-            tree.internal_value[tree.num_leaves - 2] = float(r[REC_INTERNAL_VALUE])
+                right_cnt=int(round(float(r[REC_RIGHT_CNT]))))
         return tree

@@ -67,6 +67,9 @@ class CompactState(NamedTuple):
     num_leaves: jax.Array
     rec_f: jax.Array       # (L-1, NUM_REC_FIELDS) f32
     rec_i: jax.Array       # (L-1, 2) int32 — exact bagged left/right counts
+    rec_cat: jax.Array     # (L-1, W) uint32 — bin bitset of cat splits
+    leaf_min_c: jax.Array  # (L,) monotone value constraints per leaf
+    leaf_max_c: jax.Array
 
 
 class CompactTPUTreeLearner(TPUTreeLearner):
@@ -150,7 +153,7 @@ class CompactTPUTreeLearner(TPUTreeLearner):
         fw, n = self.fw, self.n_pad
 
         def branch(bins_p, w_p, rid_p, lid_p, s, c, feat, thr, dleft,
-                   new_leaf, do):
+                   is_cat, cat_bits, new_leaf, do):
             sa = jnp.clip(s, 0, n - S).astype(jnp.int32)
             off = (s - sa).astype(jnp.int32)
             bw = lax.dynamic_slice(bins_p, (jnp.int32(0), sa), (fw, S))
@@ -160,7 +163,8 @@ class CompactTPUTreeLearner(TPUTreeLearner):
             pos = jnp.arange(S, dtype=jnp.int32)
             in_seg = (pos >= off) & (pos < off + c)
             # decision on the split feature (NumericalDecisionInner,
-            # `tree.h:233-249`) — unpack the one feature from its word
+            # `tree.h:233-249`; CategoricalDecisionInner `tree.h:270-277`)
+            # — unpack the one feature from its word
             word = lax.dynamic_slice(bw, (feat // 4, jnp.int32(0)), (1, S))[0]
             frow = (word >> ((feat % 4) * 8)) & 0xFF
             mt = self.f_missing[feat]
@@ -169,6 +173,10 @@ class CompactTPUTreeLearner(TPUTreeLearner):
             is_missing = ((mt == MISSING_ZERO) & (frow == db)) | \
                          ((mt == MISSING_NAN) & (frow == nb - 1))
             go_left = jnp.where(is_missing, dleft, frow <= thr)
+            if self.has_categorical:
+                cat_left = (cat_bits[frow >> 5]
+                            >> (frow & 31).astype(jnp.uint32)) & 1
+                go_left = jnp.where(is_cat, cat_left.astype(bool), go_left)
             key = jnp.where(in_seg,
                             jnp.where(go_left, 1, 2),
                             jnp.where(pos < off, 0, 3)).astype(jnp.int32)
@@ -197,22 +205,30 @@ class CompactTPUTreeLearner(TPUTreeLearner):
     # -- per-leaf candidates -------------------------------------------------
 
     def _leaf_cands_pair(self, hist_l, hist_r, info, feature_mask,
-                         depth_ok) -> Tuple[_LeafCand, _LeafCand]:
+                         depth_ok, constraints=None
+                         ) -> Tuple[_LeafCand, _LeafCand]:
         """Best splits for both children in one batched scan."""
         hist2 = jnp.stack([hist_l, hist_r])
         sg = jnp.stack([info.left_sum_g, info.right_sum_g])
         sh = jnp.stack([info.left_sum_h, info.right_sum_h])
         cn = jnp.stack([info.left_cnt, info.right_cnt])
-        fmask = feature_mask & self._cat_mask
 
-        cands = jax.vmap(
-            lambda h, g, hh, c: find_best_splits(
-                h, g, hh, c, self.f_num_bin, self.f_missing,
-                self.f_default_bin, fmask, **self._split_kwargs)
-        )(hist2, sg, sh, cn)
+        if constraints is not None:
+            mins, maxs = constraints
+            cands = jax.vmap(
+                lambda h, g, hh, c, mn, mx: self._feature_cands(
+                    h, g, hh, c, feature_mask, mn, mx)
+            )(hist2, sg, sh, cn, mins, maxs)
+        else:
+            cands = jax.vmap(
+                lambda h, g, hh, c: self._feature_cands(h, g, hh, c,
+                                                        feature_mask)
+            )(hist2, sg, sh, cn)
 
         best_f = jnp.argmax(cands.gain, axis=1).astype(jnp.int32)  # (2,)
         pick = lambda a: jnp.take_along_axis(a, best_f[:, None], axis=1)[:, 0]
+        pick_bits = lambda a: jnp.take_along_axis(
+            a, best_f[:, None, None], axis=1)[:, 0]
         out = []
         for i in range(2):
             lc = _LeafCand(
@@ -220,6 +236,8 @@ class CompactTPUTreeLearner(TPUTreeLearner):
                 feature=best_f[i],
                 threshold=pick(cands.threshold)[i],
                 default_left=pick(cands.default_left)[i],
+                is_cat=pick(cands.is_cat)[i],
+                cat_bits=pick_bits(cands.cat_bits)[i],
                 left_sum_g=pick(cands.left_sum_g)[i],
                 left_sum_h=pick(cands.left_sum_h)[i],
                 left_cnt=pick(cands.left_cnt)[i],
@@ -273,7 +291,10 @@ class CompactTPUTreeLearner(TPUTreeLearner):
             cand=cand_L,
             num_leaves=jnp.asarray(1, jnp.int32),
             rec_f=jnp.zeros((L - 1, NUM_REC_FIELDS), jnp.float32),
-            rec_i=jnp.zeros((L - 1, 2), jnp.int32))
+            rec_i=jnp.zeros((L - 1, 2), jnp.int32),
+            rec_cat=jnp.zeros((L - 1, self.cat_W), jnp.uint32),
+            leaf_min_c=jnp.full(L, -jnp.inf, jnp.float32),
+            leaf_max_c=jnp.full(L, jnp.inf, jnp.float32))
 
     # -- one split -----------------------------------------------------------
 
@@ -294,7 +315,7 @@ class CompactTPUTreeLearner(TPUTreeLearner):
         bins_p, w_p, rid_p, lid_p, lc_w, lc_bag, c_bag = lax.switch(
             pidx, self._partition_branches, state.bins_p, state.w_p,
             state.rid_p, state.lid_p, s, c, info.feature, info.threshold,
-            info.default_left, new_leaf, do)
+            info.default_left, info.is_cat, info.cat_bits, new_leaf, do)
         rc_w = c - lc_w
 
         # ---- smaller-child histogram + sibling subtraction
@@ -333,11 +354,22 @@ class CompactTPUTreeLearner(TPUTreeLearner):
             jnp.where(do, s + lc_w, state.leaf_start[new_leaf]))
         leaf_wcnt = upd(state.leaf_wcnt, lc_w, rc_w)
 
-        # ---- children's best splits
+        # ---- children's best splits (with monotone constraint propagation)
         md = int(cfg.max_depth)
         depth_ok = jnp.asarray(True) if md <= 0 else (child_depth < md)
+        if self.has_monotone:
+            pmin = state.leaf_min_c[best_leaf]
+            pmax = state.leaf_max_c[best_leaf]
+            lmin, lmax, rmin, rmax = self._child_constraints(info, pmin, pmax)
+            leaf_min_c = upd(state.leaf_min_c, lmin, rmin)
+            leaf_max_c = upd(state.leaf_max_c, lmax, rmax)
+            constraints = (jnp.stack([lmin, rmin]), jnp.stack([lmax, rmax]))
+        else:
+            leaf_min_c = state.leaf_min_c
+            leaf_max_c = state.leaf_max_c
+            constraints = None
         cand_left, cand_right = self._leaf_cands_pair(
-            hist_left, hist_right, info, feature_mask, depth_ok)
+            hist_left, hist_right, info, feature_mask, depth_ok, constraints)
 
         def upd_cand(arr, l_val, r_val):
             return (arr.at[best_leaf].set(jnp.where(do, l_val, arr[best_leaf]))
@@ -362,10 +394,12 @@ class CompactTPUTreeLearner(TPUTreeLearner):
             info.left_sum_h.astype(jnp.float32),
             info.right_sum_h.astype(jnp.float32),
             info.left_sum_g.astype(jnp.float32),
-            info.right_sum_g.astype(jnp.float32)])
+            info.right_sum_g.astype(jnp.float32),
+            info.is_cat.astype(jnp.float32)])
         rec_f = state.rec_f.at[step_idx].set(rec)
         rec_i = state.rec_i.at[step_idx].set(
             jnp.stack([lc_bag, c_bag - lc_bag]).astype(jnp.int32))
+        rec_cat = state.rec_cat.at[step_idx].set(info.cat_bits)
 
         return CompactState(
             bins_p=bins_p, w_p=w_p, rid_p=rid_p, lid_p=lid_p,
@@ -373,7 +407,8 @@ class CompactTPUTreeLearner(TPUTreeLearner):
             leaf_sum_g=leaf_sum_g, leaf_sum_h=leaf_sum_h, leaf_cnt=leaf_cnt,
             leaf_output=leaf_output, leaf_depth=leaf_depth, cand=new_cand,
             num_leaves=state.num_leaves + do.astype(jnp.int32),
-            rec_f=rec_f, rec_i=rec_i)
+            rec_f=rec_f, rec_i=rec_i, rec_cat=rec_cat,
+            leaf_min_c=leaf_min_c, leaf_max_c=leaf_max_c)
 
     # -- whole tree ----------------------------------------------------------
 
@@ -391,54 +426,43 @@ class CompactTPUTreeLearner(TPUTreeLearner):
         # leaf partition in ORIGINAL row order for the score updater
         leaf_id = jnp.zeros(self.n_pad, jnp.int32).at[state.rid_p].set(
             state.lid_p)
-        return state.rec_f, state.rec_i, leaf_id, state.leaf_output
+        return (state.rec_f, state.rec_i, state.rec_cat, leaf_id,
+                state.leaf_output)
 
     # -- host orchestration --------------------------------------------------
 
     def train_async(self, grad: jax.Array, hess: jax.Array, bag: jax.Array,
                     feature_mask: Optional[jax.Array] = None):
         """Dispatch one tree build; returns device arrays with NO host sync:
-        (rec_f, rec_i, leaf_id, leaf_output)."""
+        (rec_f, rec_i, rec_cat, leaf_id, leaf_output)."""
         if feature_mask is None:
             feature_mask = jnp.ones(self.num_features, dtype=bool)
         self.bins_packed()  # materialize the cache outside the trace
         return self._jit_tree_c(grad, hess, bag, feature_mask)
 
-    def assemble_host(self, rec_f, rec_i) -> Tree:
-        return self._assemble_compact(np.asarray(rec_f), np.asarray(rec_i))
+    def assemble_host(self, rec_f, rec_i, rec_cat=None) -> Tree:
+        return self._assemble_compact(
+            np.asarray(rec_f), np.asarray(rec_i),
+            None if rec_cat is None else np.asarray(rec_cat))
 
     def train(self, grad: jax.Array, hess: jax.Array, bag: jax.Array,
               feature_mask: Optional[jax.Array] = None, fused: bool = True
               ) -> Tuple[Tree, jax.Array]:
-        rec_f, rec_i, leaf_id, _ = self.train_async(grad, hess, bag,
-                                                    feature_mask)
-        tree = self.assemble_host(rec_f, rec_i)
+        rec_f, rec_i, rec_cat, leaf_id, _ = self.train_async(
+            grad, hess, bag, feature_mask)
+        tree = self.assemble_host(rec_f, rec_i, rec_cat)
         return tree, leaf_id
 
-    def _assemble_compact(self, rec_f: np.ndarray, rec_i: np.ndarray) -> Tree:
+    def _assemble_compact(self, rec_f: np.ndarray, rec_i: np.ndarray,
+                          rec_cat: Optional[np.ndarray] = None) -> Tree:
         tree = Tree(self.num_leaves)
-        used_map = self.data.used_feature_map
         for i in range(rec_f.shape[0]):
             r = rec_f[i]
             if r[REC_VALID] < 0.5:
                 break
-            fi = int(r[REC_FEATURE])
-            thr_bin = int(r[REC_THRESHOLD])
-            mapper = self.data.bin_mappers[fi]
-            tree.split(
-                leaf=int(r[REC_LEAF]), feature_inner=fi,
-                real_feature=int(used_map[fi]),
-                threshold_bin=thr_bin,
-                threshold_double=mapper.bin_to_value(thr_bin),
-                left_value=float(r[REC_LEFT_OUT]),
-                right_value=float(r[REC_RIGHT_OUT]),
-                left_cnt=int(rec_i[i, 0]),
-                right_cnt=int(rec_i[i, 1]),
-                gain=float(r[REC_GAIN]),
-                missing_type=int(self.np_missing[fi]),
-                default_left=bool(r[REC_DEFAULT_LEFT] > 0.5))
-            tree.internal_value[tree.num_leaves - 2] = \
-                float(r[REC_INTERNAL_VALUE])
+            self._split_host_tree(
+                tree, r, None if rec_cat is None else rec_cat[i],
+                left_cnt=int(rec_i[i, 0]), right_cnt=int(rec_i[i, 1]))
         return tree
 
 

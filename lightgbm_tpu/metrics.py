@@ -284,7 +284,55 @@ class KLDivergenceMetric(Metric):
         return [("kldiv", self._presum + self._avg(xent))]
 
 
-class NDCGMetric(Metric):
+class _RankMetricBase(Metric):
+    """Shared fully-vectorized ranking machinery: ONE stable lexsort of all
+    documents by (query, -score) per eval instead of a Python loop over
+    queries — MSLR-scale (30k+ queries) evals run in milliseconds.  Queries
+    are contiguous blocks in the row axis, so sorting by (qid, -score)
+    leaves every block in place with its docs ranked; the within-query rank
+    of sorted position i is ``i - query_start(i)``."""
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        if metadata.query_boundaries is None:
+            raise ValueError(f"{self.name} metric requires query information")
+        self.qb = np.asarray(metadata.query_boundaries, dtype=np.int64)
+        self.eval_at = list(self.cfg.eval_at)
+        self.nq = len(self.qb) - 1
+        sizes = np.diff(self.qb)
+        self.qid = np.repeat(np.arange(self.nq, dtype=np.int64), sizes)
+        self.rank_pos = np.arange(num_data, dtype=np.int64) - \
+            self.qb[self.qid]
+
+    @staticmethod
+    def _stable_argsort_u32(keys: np.ndarray) -> np.ndarray:
+        """Stable ascending argsort of uint32 keys via two uint16 radix
+        passes — numpy's stable sort is radix only for <=16-bit dtypes, and
+        this is ~5x faster than one mergesort at 4M keys."""
+        lo = (keys & np.uint32(0xFFFF)).astype(np.uint16)
+        o = np.argsort(lo, kind="stable")
+        hi = (keys >> np.uint32(16)).astype(np.uint16)
+        return o[np.argsort(hi[o], kind="stable")]
+
+    def _ranked(self, score):
+        """Per-doc within-query rank ordering by descending score (stable —
+        ties keep document order, matching per-query mergesort argsort).
+        Keys are f32: the training scores are f32 sums already; values that
+        collide in f32 rank in document order."""
+        s = np.ascontiguousarray(
+            np.asarray(score, dtype=np.float32)[:self.num_data])
+        u = s.view(np.uint32)
+        # IEEE754 -> order-preserving uint, then invert for descending
+        u = np.where(u >> np.uint32(31), ~u, u | np.uint32(0x80000000))
+        o = self._stable_argsort_u32(~u)
+        # stable regroup into contiguous query blocks
+        q = self.qid[o]
+        if self.nq <= 0xFFFF:
+            return o[np.argsort(q.astype(np.uint16), kind="stable")]
+        return o[self._stable_argsort_u32(q.astype(np.uint32))]
+
+
+class NDCGMetric(_RankMetricBase):
     """`src/metric/rank_metric.hpp:15-130` + DCGCalculator."""
     name = "ndcg"
     is_higher_better = True
@@ -295,66 +343,61 @@ class NDCGMetric(Metric):
         lg = self.cfg.label_gain
         self.label_gain = np.asarray(lg, dtype=np.float64) if lg \
             else default_label_gain()
-        if metadata.query_boundaries is None:
-            raise ValueError("NDCG metric requires query information")
-        self.qb = metadata.query_boundaries
-        self.eval_at = list(self.cfg.eval_at)
+        self.label_int = self.label.astype(np.int64)
+        if self.label_int.size and \
+                int(self.label_int.max()) >= len(self.label_gain):
+            # reference is fatal here (`dcg_calculator.cpp` CheckLabel)
+            raise ValueError(
+                f"Label {int(self.label_int.max())} exceeds label_gain size "
+                f"{len(self.label_gain)}; set label_gain explicitly")
+        self.label_int = np.clip(self.label_int, 0, None)
+        self.discount = 1.0 / np.log2(self.rank_pos + 2.0)
+        # max DCG@k is score-independent — precompute per (k, query) once
+        ideal = np.lexsort((-self.label_int, self.qid))
+        ideal_gain = self.label_gain[self.label_int[ideal]] * self.discount
+        self.max_dcg = {
+            k: np.bincount(self.qid,
+                           weights=ideal_gain * (self.rank_pos < k),
+                           minlength=self.nq)
+            for k in self.eval_at}
 
     def eval(self, score, objective=None):
-        score = np.asarray(score, dtype=np.float64)[:self.num_data]
+        order = self._ranked(score)
+        gain_sorted = self.label_gain[self.label_int[order]] * self.discount
         results = []
-        nq = len(self.qb) - 1
-        # per-query weights (reference uses metadata query weights; default 1)
-        sum_w = float(nq)
         for k in self.eval_at:
-            total = 0.0
-            for qi in range(nq):
-                lo, hi = self.qb[qi], self.qb[qi + 1]
-                lab = self.label[lo:hi].astype(np.int64)
-                sc = score[lo:hi]
-                maxdcg = self._dcg_at_k(k, np.sort(lab)[::-1])
-                if maxdcg <= 0:
-                    total += 1.0
-                else:
-                    order = np.argsort(-sc, kind="mergesort")
-                    total += self._dcg_at_k(k, lab[order]) / maxdcg
-            results.append((f"ndcg@{k}", total / sum_w))
+            dcg = np.bincount(self.qid, weights=gain_sorted *
+                              (self.rank_pos < k), minlength=self.nq)
+            maxd = self.max_dcg[k]
+            ndcg = np.where(maxd > 0, dcg / np.where(maxd > 0, maxd, 1.0),
+                            1.0)
+            results.append((f"ndcg@{k}", float(ndcg.sum() / self.nq)))
         return results
 
-    def _dcg_at_k(self, k, labels):
-        top = labels[:k]
-        disc = 1.0 / np.log2(np.arange(len(top)) + 2.0)
-        return float((self.label_gain[top] * disc).sum())
 
-
-class MapMetric(Metric):
+class MapMetric(_RankMetricBase):
     """`src/metric/map_metric.hpp:15-120` — mean average precision@k."""
     name = "map"
     is_higher_better = True
 
-    def init(self, metadata, num_data):
-        super().init(metadata, num_data)
-        if metadata.query_boundaries is None:
-            raise ValueError("MAP metric requires query information")
-        self.qb = metadata.query_boundaries
-        self.eval_at = list(self.cfg.eval_at)
-
     def eval(self, score, objective=None):
-        score = np.asarray(score, dtype=np.float64)[:self.num_data]
-        nq = len(self.qb) - 1
+        order = self._ranked(score)
+        rel = (self.label[order] > 0).astype(np.float64)
+        cum = np.cumsum(rel)
+        # hits within the query up to and including this rank
+        start_base = cum[self.qb[:-1]] - rel[self.qb[:-1]]
+        hits = cum - start_base[self.qid]
+        prec = rel * hits / (self.rank_pos + 1.0)
         results = []
         for k in self.eval_at:
-            total = 0.0
-            for qi in range(nq):
-                lo, hi = self.qb[qi], self.qb[qi + 1]
-                lab = (self.label[lo:hi] > 0).astype(np.float64)
-                order = np.argsort(-score[lo:hi], kind="mergesort")
-                rel = lab[order][:k]
-                hits = np.cumsum(rel)
-                denom = np.arange(1, len(rel) + 1)
-                npos = rel.sum()
-                total += float((rel * hits / denom).sum() / npos) if npos > 0 else 0.0
-            results.append((f"map@{k}", total / nq))
+            topk = self.rank_pos < k
+            sum_prec = np.bincount(self.qid, weights=prec * topk,
+                                   minlength=self.nq)
+            npos = np.bincount(self.qid, weights=rel * topk,
+                               minlength=self.nq)
+            ap = np.where(npos > 0,
+                          sum_prec / np.where(npos > 0, npos, 1.0), 0.0)
+            results.append((f"map@{k}", float(ap.sum() / self.nq)))
         return results
 
 

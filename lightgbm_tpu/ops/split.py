@@ -83,11 +83,22 @@ def leaf_split_gain(sum_g, sum_h, l1, l2, max_delta_step):
     return leaf_split_gain_given_output(sum_g, sum_h, l1, l2, out)
 
 
-def _split_gains(lg, lh, rg, rh, l1, l2, mds):
+def _split_gains(lg, lh, rg, rh, l1, l2, mds, min_c=None, max_c=None,
+                 monotone=None):
+    """``GetSplitGains`` (`feature_histogram.hpp:453-466`): outputs clipped
+    to the leaf's [min_c, max_c] value constraint; a monotone violation
+    (increasing but left>right, or decreasing but left<right) zeroes the
+    gain."""
     lo = calculate_leaf_output(lg, lh, l1, l2, mds)
     ro = calculate_leaf_output(rg, rh, l1, l2, mds)
+    if min_c is not None:
+        lo = jnp.clip(lo, min_c, max_c)
+        ro = jnp.clip(ro, min_c, max_c)
     gain = (leaf_split_gain_given_output(lg, lh, l1, l2, lo)
             + leaf_split_gain_given_output(rg, rh, l1, l2, ro))
+    if monotone is not None:
+        violated = ((monotone > 0) & (lo > ro)) | ((monotone < 0) & (lo < ro))
+        gain = jnp.where(violated, 0.0, gain)
     return gain, lo, ro
 
 
@@ -100,6 +111,7 @@ def find_best_splits(hist: jax.Array, sum_gradients: jax.Array,
                      sum_hessians: jax.Array, num_data: jax.Array,
                      num_bin: jax.Array, missing_type: jax.Array,
                      default_bin: jax.Array, feature_mask: jax.Array,
+                     monotone=None, min_constraint=None, max_constraint=None,
                      *, lambda_l1: float = 0.0, lambda_l2: float = 0.0,
                      max_delta_step: float = 0.0, min_data_in_leaf: int = 20,
                      min_sum_hessian_in_leaf: float = 1e-3,
@@ -158,8 +170,10 @@ def find_best_splits(hist: jax.Array, sum_gradients: jax.Array,
     valid_m1 &= (rc_m1 >= min_data_in_leaf) & (lc_m1 >= min_data_in_leaf)
     valid_m1 &= (rh_m1 >= min_sum_hessian_in_leaf) & (lh_m1 >= min_sum_hessian_in_leaf)
 
+    mono_b = None if monotone is None else monotone[:, None]
     g_m1, lo_m1, ro_m1 = _split_gains(lg_m1, lh_m1, rg_m1, rh_m1,
-                                      lambda_l1, lambda_l2, max_delta_step)
+                                      lambda_l1, lambda_l2, max_delta_step,
+                                      min_constraint, max_constraint, mono_b)
     g_m1 = jnp.where(valid_m1 & (g_m1 > min_gain_shift), g_m1, K_MIN_SCORE)
 
     # tie-break: largest threshold wins (right-to-left scan with strict >)
@@ -183,7 +197,8 @@ def find_best_splits(hist: jax.Array, sum_gradients: jax.Array,
     valid_p1 &= (lh_p1 >= min_sum_hessian_in_leaf) & (rh_p1 >= min_sum_hessian_in_leaf)
 
     g_p1, lo_p1, ro_p1 = _split_gains(lg_p1, lh_p1, rg_p1, rh_p1,
-                                      lambda_l1, lambda_l2, max_delta_step)
+                                      lambda_l1, lambda_l2, max_delta_step,
+                                      min_constraint, max_constraint, mono_b)
     g_p1 = jnp.where(valid_p1 & (g_p1 > min_gain_shift), g_p1, K_MIN_SCORE)
     best_t_p1 = jnp.argmax(g_p1, axis=1)                       # smallest thr
     best_g_p1 = jnp.max(g_p1, axis=1)

@@ -1,0 +1,238 @@
+"""Vectorized best categorical split per feature.
+
+TPU-native re-design of ``FeatureHistogram::FindBestThresholdCategorical``
+(`src/treelearner/feature_histogram.hpp:110-232`):
+
+  * one-vs-other scan when the feature has at most ``max_cat_to_onehot``
+    bins — every bin evaluated as the lone left category at once.
+  * sorted-CTR many-vs-many otherwise: bins with ``cnt >= cat_smooth`` are
+    ordered by ``g / (h + cat_smooth)`` and scanned from both ends
+    (`find_direction = {1, -1}`), accumulating up to
+    ``min(max_cat_threshold, (used+1)/2)`` categories with the
+    ``min_data_per_group`` group-size bookkeeping.  The reference's
+    sequential ``continue``/``break`` control flow becomes a
+    ``lax.scan`` carry vmapped over (feature, direction) — the ``break``
+    conditions are monotone in the scan position, the group counter is
+    scan state.
+
+The winning split is returned as a BIN-space bitset (``(F, W) uint32``)
+ready for the device partition's membership test
+(``CategoricalDecisionInner``, `tree.h:270-277`).  Gain/output math uses
+``lambda_l2`` for one-hot and ``lambda_l2 + cat_l2`` for many-vs-many,
+exactly as the reference mutates ``l2`` (`feature_histogram.hpp:125,172`).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..binning import MISSING_NONE
+from .split import (K_EPSILON, K_MIN_SCORE, _split_gains,
+                    calculate_leaf_output, leaf_split_gain)
+
+
+class CatSplitCandidates(NamedTuple):
+    """Per-feature best categorical split; ``bits`` is the bin-space
+    membership bitset of the LEFT child."""
+    gain: jax.Array          # (F,)
+    bits: jax.Array          # (F, W) uint32
+    left_sum_g: jax.Array
+    left_sum_h: jax.Array
+    left_cnt: jax.Array
+    right_sum_g: jax.Array
+    right_sum_h: jax.Array
+    right_cnt: jax.Array
+    left_output: jax.Array
+    right_output: jax.Array
+
+
+def _bits_from_member(member, b):
+    """(..., B) bool -> (..., W) uint32 bitset."""
+    w = (b + 31) // 32
+    pad = w * 32 - b
+    m = jnp.pad(member.astype(jnp.uint32), [(0, 0)] * (member.ndim - 1)
+                + [(0, pad)])
+    m = m.reshape(member.shape[:-1] + (w, 32))
+    weights = (jnp.uint32(1) << jnp.arange(32, dtype=jnp.uint32))
+    return jnp.sum(m * weights, axis=-1, dtype=jnp.uint32)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("lambda_l1", "lambda_l2", "max_delta_step",
+                     "min_data_in_leaf", "min_sum_hessian_in_leaf",
+                     "min_gain_to_split", "cat_l2", "cat_smooth",
+                     "max_cat_threshold", "max_cat_to_onehot",
+                     "min_data_per_group"))
+def find_best_splits_categorical(
+        hist: jax.Array, sum_gradients: jax.Array, sum_hessians: jax.Array,
+        num_data: jax.Array, num_bin: jax.Array, missing_type: jax.Array,
+        feature_mask: jax.Array, min_constraint=None, max_constraint=None,
+        *, lambda_l1: float = 0.0,
+        lambda_l2: float = 0.0, max_delta_step: float = 0.0,
+        min_data_in_leaf: int = 20, min_sum_hessian_in_leaf: float = 1e-3,
+        min_gain_to_split: float = 0.0, cat_l2: float = 10.0,
+        cat_smooth: float = 10.0, max_cat_threshold: int = 32,
+        max_cat_to_onehot: int = 4, min_data_per_group: int = 100
+        ) -> CatSplitCandidates:
+    """Best categorical split per feature for one leaf.
+
+    hist : (F, B, 3) — (sum_grad, sum_hess, cnt) per bin; feature_mask must
+    be False on non-categorical features (their rows are ignored).
+    sum_hessians: leaf Σh WITHOUT epsilons (2·kEpsilon added here, matching
+    ``FindBestThreshold``, `feature_histogram.hpp:79`).
+    """
+    f, b, _ = hist.shape
+    dt = hist.dtype
+    total_g = sum_gradients.astype(dt)
+    total_h = sum_hessians.astype(dt) + 2.0 * K_EPSILON
+    total_n = num_data.astype(dt)
+    hg, hh, hc = hist[..., 0], hist[..., 1], hist[..., 2]      # (F, B)
+    bins_i = jnp.arange(b, dtype=jnp.int32)[None, :]
+
+    is_full = (missing_type == MISSING_NONE)
+    used_bin = num_bin - 1 + is_full.astype(jnp.int32)          # (F,)
+    in_range = bins_i < used_bin[:, None]
+
+    gain_shift = leaf_split_gain(total_g, total_h, lambda_l1, lambda_l2,
+                                 max_delta_step)
+    min_gain_shift = gain_shift + min_gain_to_split
+
+    # ---- one-vs-other (`feature_histogram.hpp:130-161`) --------------------
+    other_g = total_g - hg
+    other_h = total_h - hh - K_EPSILON
+    other_n = total_n - hc
+    oh_valid = in_range & (hc >= min_data_in_leaf) \
+        & (hh >= min_sum_hessian_in_leaf) \
+        & (other_n >= min_data_in_leaf) \
+        & (other_h >= min_sum_hessian_in_leaf)
+    # categorical splits clip outputs to the leaf's monotone value range but
+    # carry no direction (`FindBestThresholdCategorical` passes monotone 0)
+    g_oh, _, _ = _split_gains(other_g, other_h, hg, hh + K_EPSILON,
+                              lambda_l1, lambda_l2, max_delta_step,
+                              min_constraint, max_constraint)
+    g_oh = jnp.where(oh_valid & (g_oh > min_gain_shift), g_oh, K_MIN_SCORE)
+    oh_t = jnp.argmax(g_oh, axis=1)                             # smallest t
+    oh_gain = jnp.max(g_oh, axis=1)
+    take = lambda a: jnp.take_along_axis(a, oh_t[:, None], axis=1)[:, 0]
+    oh_lg, oh_lh, oh_lc = take(hg), take(hh) + K_EPSILON, take(hc)
+
+    # ---- sorted-CTR many-vs-many (`feature_histogram.hpp:162-232`) ---------
+    l2m = lambda_l2 + cat_l2
+    eligible = in_range & (hc >= cat_smooth)
+    used_m = jnp.sum(eligible.astype(jnp.int32), axis=1)        # (F,)
+    ctr = hg / (hh + cat_smooth)
+    ctr_key = jnp.where(eligible, ctr, jnp.inf)
+    order = jnp.argsort(ctr_key, axis=1).astype(jnp.int32)      # (F, B)
+    og = jnp.take_along_axis(hg, order, axis=1)
+    ohh = jnp.take_along_axis(hh, order, axis=1)
+    oc = jnp.take_along_axis(hc, order, axis=1)
+    max_num_cat = jnp.minimum(max_cat_threshold, (used_m + 1) // 2)  # (F,)
+
+    def scan_dir(og_f, oh_f, oc_f, used_f, maxcat_f, reverse):
+        """One direction's scan for one feature; returns best (gain, i,
+        left sums)."""
+        if reverse:
+            og_f = og_f[::-1]
+            oh_f = oh_f[::-1]
+            oc_f = oc_f[::-1]
+            # reversed: position j holds sorted rank used-1-j; the first
+            # `used` entries of the reversed VALID region start at b-used
+            shift = b - used_f
+            og_f = jnp.roll(og_f, -shift)
+            oh_f = jnp.roll(oh_f, -shift)
+            oc_f = jnp.roll(oc_f, -shift)
+
+        def step(carry, i):
+            slg, slh, lcnt, grp, best_gain, best_i, blg, blh, blc, \
+                stopped = carry
+            slg = slg + og_f[i]
+            slh = slh + oh_f[i]
+            lcnt = lcnt + oc_f[i]
+            grp = grp + oc_f[i]
+            active = (i < used_f) & (i < maxcat_f) & ~stopped
+            rcnt = total_n - lcnt
+            srh = total_h - slh
+            brk = (rcnt < min_data_in_leaf) | (rcnt < min_data_per_group) \
+                | (srh < min_sum_hessian_in_leaf)
+            stopped = stopped | (active & brk)
+            can_eval = active & ~brk \
+                & (lcnt >= min_data_in_leaf) \
+                & (slh >= min_sum_hessian_in_leaf) \
+                & (grp >= min_data_per_group)
+            gain, _, _ = _split_gains(slg, slh, total_g - slg, srh,
+                                      lambda_l1, l2m, max_delta_step,
+                                      min_constraint, max_constraint)
+            ok = can_eval & (gain > min_gain_shift)
+            grp = jnp.where(can_eval, 0.0, grp)
+            better = ok & (gain > best_gain)
+            best_gain = jnp.where(better, gain, best_gain)
+            best_i = jnp.where(better, i, best_i)
+            blg = jnp.where(better, slg, blg)
+            blh = jnp.where(better, slh, blh)
+            blc = jnp.where(better, lcnt, blc)
+            return (slg, slh, lcnt, grp, best_gain, best_i, blg, blh, blc,
+                    stopped), None
+
+        z = jnp.asarray(0.0, dt)
+        init = (z, z + K_EPSILON, z, z, jnp.asarray(K_MIN_SCORE, dt),
+                jnp.int32(-1), z, z, z, jnp.asarray(False))
+        carry, _ = jax.lax.scan(step, init,
+                                jnp.arange(b, dtype=jnp.int32))
+        _, _, _, _, best_gain, best_i, blg, blh, blc, _ = carry
+        return best_gain, best_i, blg, blh, blc
+
+    fwd = jax.vmap(lambda a, h_, c, u, m: scan_dir(a, h_, c, u, m, False))(
+        og, ohh, oc, used_m, max_num_cat)
+    bwd = jax.vmap(lambda a, h_, c, u, m: scan_dir(a, h_, c, u, m, True))(
+        og, ohh, oc, used_m, max_num_cat)
+    # direction merge: strict >, forward scanned first (`find_direction`
+    # order {1, -1} with `current_gain > best_gain`)
+    use_bwd = bwd[0] > fwd[0]
+    mv_gain = jnp.where(use_bwd, bwd[0], fwd[0])
+    mv_i = jnp.where(use_bwd, bwd[1], fwd[1])
+    mv_lg = jnp.where(use_bwd, bwd[2], fwd[2])
+    mv_lh = jnp.where(use_bwd, bwd[3], fwd[3])
+    mv_lc = jnp.where(use_bwd, bwd[4], fwd[4])
+
+    # membership: sorted rank r (ascending ctr); forward takes r <= i,
+    # backward takes r >= used-1-i
+    rank = jnp.argsort(order, axis=1)                           # (F, B) rank of bin
+    mv_member = jnp.where(
+        use_bwd[:, None],
+        rank >= (used_m - 1 - mv_i)[:, None],
+        rank <= mv_i[:, None]) & eligible
+
+    # ---- choose scan per feature (`num_bin <= max_cat_to_onehot`) ----------
+    use_onehot = num_bin <= max_cat_to_onehot
+    gain = jnp.where(use_onehot, oh_gain, mv_gain)
+    lg = jnp.where(use_onehot, oh_lg, mv_lg)
+    lh = jnp.where(use_onehot, oh_lh, mv_lh)
+    lc = jnp.where(use_onehot, oh_lc, mv_lc)
+    member = jnp.where(use_onehot[:, None],
+                       bins_i == oh_t[:, None], mv_member)
+    l2_eff = jnp.where(use_onehot, lambda_l2, l2m)
+
+    rg = total_g - lg
+    rh = total_h - lh
+    rc = total_n - lc
+    lo = calculate_leaf_output(lg, lh, lambda_l1, l2_eff, max_delta_step)
+    ro = calculate_leaf_output(rg, rh, lambda_l1, l2_eff, max_delta_step)
+    if min_constraint is not None:
+        lo = jnp.clip(lo, min_constraint, max_constraint)
+        ro = jnp.clip(ro, min_constraint, max_constraint)
+
+    invalid = jnp.isneginf(gain) | ~feature_mask
+    gain_out = jnp.where(invalid, K_MIN_SCORE, gain - min_gain_shift)
+    bits = _bits_from_member(member & ~invalid[:, None], b)
+
+    return CatSplitCandidates(
+        gain=gain_out, bits=bits,
+        left_sum_g=lg, left_sum_h=lh - K_EPSILON, left_cnt=lc,
+        right_sum_g=rg, right_sum_h=rh - K_EPSILON, right_cnt=rc,
+        left_output=lo, right_output=ro)

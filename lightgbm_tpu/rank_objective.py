@@ -68,20 +68,30 @@ class LambdarankNDCG(ObjectiveFunction):
         # pad to the next power of two for shape reuse across datasets
         self.q_pad = max(8, 1 << (qmax - 1).bit_length())
         nq = self.num_queries
+        n = num_data
+        qid = np.repeat(np.arange(nq, dtype=np.int64), sizes)
+        within = np.arange(n, dtype=np.int64) - qb[qid]
         # (nq, Q) doc index matrix into the padded row axis (-1 = padding)
         doc_idx = np.full((nq, self.q_pad), -1, dtype=np.int32)
-        for qi in range(nq):
-            doc_idx[qi, :sizes[qi]] = np.arange(qb[qi], qb[qi + 1])
+        doc_idx[qid, within] = np.arange(n, dtype=np.int32)
         self.doc_idx = jnp.asarray(doc_idx)
         self.doc_valid = jnp.asarray(doc_idx >= 0)
         labels = np.where(doc_idx >= 0, self._pad_gather(metadata.label, doc_idx), -1)
         self.q_labels = jnp.asarray(labels.astype(np.int32))
-        inv = np.zeros(nq)
-        for qi in range(nq):
-            m = max_dcg_at_k(self.optimize_pos_at,
-                             metadata.label[qb[qi]:qb[qi + 1]].astype(np.int64),
-                             self.label_gain)
-            inv[qi] = 1.0 / m if m > 0 else 0.0
+        # max DCG@k per query, vectorized: one stable (qid, -label) sort
+        lab_int = metadata.label.astype(np.int64)
+        if lab_int.size and int(lab_int.max()) >= len(self.label_gain):
+            raise ValueError(
+                f"Label {int(lab_int.max())} exceeds label_gain size "
+                f"{len(self.label_gain)}; set label_gain explicitly")
+        lab_int = np.clip(lab_int, 0, None)
+        ideal = np.lexsort((-lab_int, qid))
+        disc = 1.0 / np.log2(within + 2.0)
+        k = self.optimize_pos_at
+        gains = self.label_gain[lab_int[ideal]] * disc * (within < k)
+        maxdcg = np.bincount(qid, weights=gains, minlength=nq)
+        inv = np.where(maxdcg > 0, 1.0 / np.where(maxdcg > 0, maxdcg, 1.0),
+                       0.0)
         self.inverse_max_dcgs = jnp.asarray(inv.astype(np.float32))
         self.gains_lut = jnp.asarray(self.label_gain.astype(np.float32))
         # batch queries so the (qb, Q, Q) intermediate stays bounded (~256MB f32)

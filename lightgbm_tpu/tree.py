@@ -123,11 +123,9 @@ class Tree:
                           left_value: float, right_value: float, left_cnt: int,
                           right_cnt: int, gain: float, missing_type: int) -> int:
         """Categorical split storing bitsets (`tree.h:73-108` SplitCategorical)."""
-        new_node = self.num_leaves - 1
         cat_idx = self.num_cat
-        # bitset over category values for the outer threshold
-        self.threshold_in_bin = self.threshold_in_bin.astype(np.float64) \
-            if self.threshold_in_bin.dtype != np.float64 else self.threshold_in_bin
+        # threshold fields hold the categorical-split index into
+        # cat_boundaries (`tree.h:93-101`); default direction is right
         right = self.split(leaf, feature_inner, real_feature, cat_idx,
                            float(cat_idx), left_value, right_value, left_cnt,
                            right_cnt, gain, missing_type, False)
@@ -305,6 +303,52 @@ class Tree:
                 f"malformed tree: walked {len(visited)} internal nodes / "
                 f"{len(leaves_seen)} leaves, expected "
                 f"{self.num_leaves - 1} / {self.num_leaves}")
+
+    # -- JSON dump (Tree::ToJSON, `src/io/tree.cpp:215-313`) -----------------
+
+    def to_json(self) -> Dict:
+        out = {"num_leaves": int(self.num_leaves),
+               "num_cat": int(self.num_cat),
+               "shrinkage": float(self.shrinkage)}
+        if self.num_leaves == 1:
+            out["tree_structure"] = {"leaf_value": float(self.leaf_value[0])}
+        else:
+            out["tree_structure"] = self._node_to_json(0)
+        return out
+
+    def _node_to_json(self, index: int) -> Dict:
+        if index >= 0:
+            dt = int(self.decision_type[index])
+            node = {
+                "split_index": index,
+                "split_feature": int(self.split_feature[index]),
+                "split_gain": float(self.split_gain[index]),
+            }
+            if dt & K_CATEGORICAL_MASK:
+                cat_idx = int(self.threshold[index])
+                lo, hi = self.cat_boundaries[cat_idx], \
+                    self.cat_boundaries[cat_idx + 1]
+                cats = [c for c in range(32 * (hi - lo))
+                        if _in_bitset(self.cat_threshold, lo, hi, c)]
+                node["threshold"] = "||".join(str(c) for c in cats)
+                node["decision_type"] = "=="
+            else:
+                node["threshold"] = _avoid_inf(float(self.threshold[index]))
+                node["decision_type"] = "<="
+            node["default_left"] = bool(dt & K_DEFAULT_LEFT_MASK)
+            node["missing_type"] = {0: "None", 1: "Zero", 2: "NaN"}[
+                (dt >> 2) & 3]
+            node["internal_value"] = float(self.internal_value[index])
+            node["internal_count"] = int(self.internal_count[index])
+            node["left_child"] = self._node_to_json(
+                int(self.left_child[index]))
+            node["right_child"] = self._node_to_json(
+                int(self.right_child[index]))
+            return node
+        leaf = ~index
+        return {"leaf_index": leaf,
+                "leaf_value": float(self.leaf_value[leaf]),
+                "leaf_count": int(self.leaf_count[leaf])}
 
     # -- packed arrays for the device batch predictor ------------------------
 

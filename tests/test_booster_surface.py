@@ -1,0 +1,141 @@
+"""Booster/Dataset surface added in round 3: dump_model JSON, refit,
+save_binary, subset, add_features_from."""
+
+import json
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+@pytest.fixture()
+def xy(rng):
+    X = rng.randn(800, 5)
+    y = X[:, 0] * 2 - X[:, 1] + 0.3 * rng.randn(800)
+    return X, y
+
+
+PARAMS = {"objective": "regression", "num_leaves": 15, "verbosity": -1,
+          "min_data_in_leaf": 20}
+
+
+def test_dump_model_schema(xy):
+    X, y = xy
+    bst = lgb.train(PARAMS, lgb.Dataset(X, label=y), 5)
+    d = bst.dump_model()
+    json.dumps(d)  # JSON-serializable
+    assert d["num_class"] == 1
+    assert d["num_tree_per_iteration"] == 1
+    assert d["max_feature_idx"] == 4
+    assert len(d["feature_names"]) == 5
+    assert len(d["tree_info"]) == 5
+    t0 = d["tree_info"][0]
+    assert t0["tree_index"] == 0
+    assert t0["num_leaves"] == 15
+    root = t0["tree_structure"]
+    # reference node schema (`src/io/tree.cpp:230-313`)
+    for key in ("split_index", "split_feature", "split_gain", "threshold",
+                "decision_type", "default_left", "missing_type",
+                "internal_value", "internal_count", "left_child",
+                "right_child"):
+        assert key in root, key
+    assert root["decision_type"] == "<="
+
+    def count_leaves(node):
+        if "leaf_index" in node:
+            assert "leaf_value" in node and "leaf_count" in node
+            return 1
+        return count_leaves(node["left_child"]) + \
+            count_leaves(node["right_child"])
+
+    assert count_leaves(root) == 15
+
+
+def test_dump_model_categorical_nodes(rng):
+    X = np.column_stack([rng.randint(0, 12, 600).astype(float),
+                         rng.randn(600)])
+    y = (X[:, 0] % 3) + 0.1 * rng.randn(600)
+    bst = lgb.train(PARAMS, lgb.Dataset(X, label=y, categorical_feature=[0]),
+                    3)
+    d = bst.dump_model()
+
+    def find_cat(node):
+        if "leaf_index" in node:
+            return None
+        if node["decision_type"] == "==":
+            return node
+        return find_cat(node["left_child"]) or find_cat(node["right_child"])
+
+    cat_node = next((c for c in (find_cat(t["tree_structure"])
+                                 for t in d["tree_info"]) if c), None)
+    assert cat_node is not None
+    cats = [int(c) for c in cat_node["threshold"].split("||")]
+    assert all(0 <= c < 12 for c in cats)
+
+
+def test_refit_moves_leaf_values_toward_new_data(xy, rng):
+    X, y = xy
+    bst = lgb.train(PARAMS, lgb.Dataset(X, label=y), 10)
+    y2 = y + 5.0  # shifted target
+    refitted = bst.refit(X, y2, decay_rate=0.0)
+    # structure identical, leaf values adapted to the new labels
+    assert refitted.num_trees() == bst.num_trees()
+    p_old = bst.predict(X)
+    p_new = refitted.predict(X)
+    assert abs(np.mean(p_new) - np.mean(y2)) < abs(np.mean(p_old) - np.mean(y2))
+    for t_old, t_new in zip(bst.gbdt.models, refitted.gbdt.models):
+        np.testing.assert_array_equal(
+            t_old.split_feature[:t_old.num_leaves - 1],
+            t_new.split_feature[:t_new.num_leaves - 1])
+    # decay=1.0 keeps the old model exactly
+    kept = bst.refit(X, y2, decay_rate=1.0)
+    np.testing.assert_allclose(kept.predict(X), p_old, rtol=1e-6)
+
+
+def test_save_binary_roundtrip(xy, tmp_path):
+    X, y = xy
+    ds = lgb.Dataset(X, label=y, params={"max_bin": 63})
+    ds.save_binary(str(tmp_path / "train.bin"))
+    ds2 = lgb.Dataset(str(tmp_path / "train.bin"))
+    ds2.construct()
+    con, con2 = ds.constructed, ds2.constructed
+    np.testing.assert_array_equal(con.bins, con2.bins)
+    np.testing.assert_array_equal(con.metadata.label, con2.metadata.label)
+    assert [m.to_dict() for m in con.bin_mappers] == \
+        [m.to_dict() for m in con2.bin_mappers]
+    # training from the binary cache matches training from raw data
+    b1 = lgb.train(dict(PARAMS, max_bin=63), lgb.Dataset(X, label=y,
+                   params={"max_bin": 63}), 5)
+    b2 = lgb.train(dict(PARAMS, max_bin=63),
+                   lgb.Dataset(str(tmp_path / "train.bin")), 5)
+    np.testing.assert_allclose(b1.predict(X), b2.predict(X), rtol=1e-6)
+
+
+def test_subset(xy):
+    X, y = xy
+    ds = lgb.Dataset(X, label=y)
+    ds.construct()
+    idx = np.arange(0, 800, 2)
+    sub = ds.subset(idx)
+    assert sub.num_data() == 400
+    np.testing.assert_array_equal(np.asarray(sub.get_label()),
+                                  y[idx].astype(np.float32))
+    # binning is shared — training on the subset works end to end
+    bst = lgb.train(PARAMS, sub, 3)
+    assert bst.num_trees() == 3
+
+
+def test_add_features_from(rng):
+    n = 600
+    Xa = rng.randn(n, 3)
+    Xb = rng.randn(n, 2)
+    y = Xa[:, 0] + Xb[:, 1] + 0.1 * rng.randn(n)
+    da = lgb.Dataset(Xa, label=y)
+    db = lgb.Dataset(Xb)
+    da.add_features_from(db)
+    assert da.num_feature() == 5
+    bst = lgb.train(PARAMS, da, 5)
+    imp = bst.feature_importance("split")
+    assert len(imp) == 5
+    assert imp[0] > 0 and imp[4] > 0  # both sources' features used

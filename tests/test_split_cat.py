@@ -1,0 +1,185 @@
+"""Categorical split finder vs a direct numpy port of the reference loop
+(`src/treelearner/feature_histogram.hpp:110-232`)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from lightgbm_tpu.binning import MISSING_NONE, MISSING_NAN
+from lightgbm_tpu.ops.split_cat import find_best_splits_categorical
+
+K_EPS = 1e-15
+
+
+def _leaf_out(g, h, l1, l2, mds):
+    reg = np.sign(g) * max(0.0, abs(g) - l1)
+    ret = -reg / (h + l2)
+    if mds > 0:
+        ret = np.clip(ret, -mds, mds)
+    return ret
+
+
+def _gain1(g, h, l1, l2, mds):
+    out = _leaf_out(g, h, l1, l2, mds)
+    reg = np.sign(g) * max(0.0, abs(g) - l1)
+    return -(2.0 * reg * out + (h + l2) * out * out)
+
+
+def _split_gain(lg, lh, rg, rh, l1, l2, mds):
+    return _gain1(lg, lh, l1, l2, mds) + _gain1(rg, rh, l1, l2, mds)
+
+
+def ref_categorical(hist, total_g, total_h, n, num_bin, missing_type, *,
+                    l1=0.0, l2=0.0, mds=0.0, min_data=20, min_hess=1e-3,
+                    min_gain=0.0, cat_l2=10.0, cat_smooth=10.0,
+                    max_cat_threshold=32, max_cat_to_onehot=4,
+                    min_data_per_group=100):
+    """Direct port of FindBestThresholdCategorical for ONE feature."""
+    total_h = total_h + 2 * K_EPS
+    hg, hh, hc = hist[:, 0], hist[:, 1], hist[:, 2]
+    gain_shift = _gain1(total_g, total_h, l1, l2, mds)
+    min_gain_shift = gain_shift + min_gain
+    is_full = missing_type == MISSING_NONE
+    used_bin = num_bin - 1 + int(is_full)
+    use_onehot = num_bin <= max_cat_to_onehot
+    best = dict(gain=-np.inf, bins=None, lg=0.0, lh=0.0, lc=0.0)
+
+    if use_onehot:
+        for t in range(used_bin):
+            if hc[t] < min_data or hh[t] < min_hess:
+                continue
+            other_cnt = n - hc[t]
+            if other_cnt < min_data:
+                continue
+            sum_other_h = total_h - hh[t] - K_EPS
+            if sum_other_h < min_hess:
+                continue
+            sum_other_g = total_g - hg[t]
+            gain = _split_gain(sum_other_g, sum_other_h, hg[t], hh[t] + K_EPS,
+                               l1, l2, mds)
+            if gain <= min_gain_shift:
+                continue
+            if gain > best["gain"]:
+                best = dict(gain=gain, bins=[t], lg=hg[t], lh=hh[t] + K_EPS,
+                            lc=hc[t])
+    else:
+        sorted_idx = [i for i in range(used_bin) if hc[i] >= cat_smooth]
+        used = len(sorted_idx)
+        l2 = l2 + cat_l2
+        ctr = lambda i: hg[i] / (hh[i] + cat_smooth)
+        sorted_idx.sort(key=ctr)
+        max_num_cat = min(max_cat_threshold, (used + 1) // 2)
+        for dir_, start in ((1, 0), (-1, used - 1)):
+            grp = 0.0
+            slg, slh, lcnt = 0.0, K_EPS, 0.0
+            pos = start
+            for i in range(min(used, max_num_cat)):
+                t = sorted_idx[pos]
+                pos += dir_
+                slg += hg[t]
+                slh += hh[t]
+                lcnt += hc[t]
+                grp += hc[t]
+                if lcnt < min_data or slh < min_hess:
+                    continue
+                rcnt = n - lcnt
+                if rcnt < min_data or rcnt < min_data_per_group:
+                    break
+                srh = total_h - slh
+                if srh < min_hess:
+                    break
+                if grp < min_data_per_group:
+                    continue
+                grp = 0.0
+                gain = _split_gain(slg, slh, total_g - slg, srh, l1, l2, mds)
+                if gain <= min_gain_shift:
+                    continue
+                if gain > best["gain"]:
+                    if dir_ == 1:
+                        bins = sorted_idx[:i + 1]
+                    else:
+                        bins = sorted_idx[used - 1 - i:]
+                    best = dict(gain=gain, bins=bins, lg=slg, lh=slh, lc=lcnt)
+    if best["bins"] is None:
+        return None
+    best["gain"] -= min_gain_shift
+    return best
+
+
+def _run_finder(hist, tg, th, n, num_bin, mtype, **kw):
+    f, b, _ = hist.shape
+    cand = find_best_splits_categorical(
+        jnp.asarray(hist), jnp.asarray(tg), jnp.asarray(th), jnp.asarray(n),
+        jnp.asarray(num_bin), jnp.asarray(mtype), jnp.ones(f, dtype=bool),
+        **kw)
+    return cand
+
+
+def _bits_to_bins(bits_row):
+    out = []
+    for w, word in enumerate(np.asarray(bits_row)):
+        for s in range(32):
+            if (int(word) >> s) & 1:
+                out.append(w * 32 + s)
+    return out
+
+
+@pytest.mark.parametrize("nbins,kw", [
+    (4, {}),                                   # one-hot regime
+    (3, {}),                                   # one-hot, tiny
+    (25, {}),                                  # sorted-CTR defaults
+    (25, {"min_data_per_group": 1}),           # group bookkeeping off
+    (25, {"max_cat_threshold": 3}),            # tight category cap
+    (40, {"cat_smooth": 25.0}),                # eligibility filter bites
+    (64, {"min_data_in_leaf": 1,
+          "min_data_per_group": 1}),           # wide, everything eligible
+])
+def test_categorical_finder_vs_reference_port(rng, nbins, kw):
+    f = 5
+    b = 64
+    hists = []
+    for _ in range(f):
+        cnt = rng.randint(0, 120, size=b).astype(np.float64)
+        cnt[nbins:] = 0.0
+        g = rng.randn(b) * np.sqrt(np.maximum(cnt, 1e-9))
+        h = cnt * 0.25 + np.abs(rng.randn(b)) * 0.01 * (cnt > 0)
+        hists.append(np.stack([g, h, cnt], axis=1))
+    hist = np.stack(hists).astype(np.float64)
+    n = hist[0, :, 2].sum()
+    num_bin = np.full(f, nbins, np.int32)
+    mtype = np.full(f, MISSING_NONE, np.int32)
+    tg = hist[:, :, 0].sum(1)
+    th = hist[:, :, 1].sum(1)
+
+    kwargs = dict(min_data_in_leaf=5, min_sum_hessian_in_leaf=1e-3)
+    kwargs.update(kw)
+    # per-feature totals differ — call finder per feature with its totals
+    for fi in range(f):
+        cand = _run_finder(hist[fi:fi + 1], tg[fi], th[fi],
+                           hist[fi, :, 2].sum(), num_bin[:1], mtype[:1],
+                           **kwargs)
+        want = ref_categorical(hist[fi], tg[fi], th[fi],
+                               hist[fi, :, 2].sum(), nbins, MISSING_NONE,
+                               min_data=kwargs["min_data_in_leaf"],
+                               min_hess=kwargs["min_sum_hessian_in_leaf"],
+                               **{k: v for k, v in kw.items()
+                                  if k not in ("min_data_in_leaf",
+                                               "min_data_per_group")},
+                               min_data_per_group=kw.get("min_data_per_group",
+                                                         100))
+        got_gain = float(cand.gain[0])
+        if want is None:
+            assert np.isneginf(got_gain), (fi, got_gain)
+            continue
+        assert np.isfinite(got_gain), (fi, "finder found nothing, want",
+                                       want["gain"])
+        np.testing.assert_allclose(got_gain, want["gain"], rtol=1e-4,
+                                   err_msg=f"feature {fi}")
+        got_bins = _bits_to_bins(cand.bits[0])
+        assert sorted(got_bins) == sorted(want["bins"]), (
+            fi, got_bins, want["bins"])
+        np.testing.assert_allclose(float(cand.left_sum_g[0]), want["lg"],
+                                   rtol=1e-4)
+        np.testing.assert_allclose(float(cand.left_cnt[0]), want["lc"],
+                                   rtol=1e-6)
