@@ -22,6 +22,12 @@ one-bit-key `lax.sort` over the parent's window at every split:
 the reference CPU budget.  Split semantics (gain math, missing handling,
 tie-breaks, min_data/min_hessian limits) are byte-identical to the masked
 learner — both call ``ops.split.find_best_splits``.
+
+Per-leaf bookkeeping lives in FUSED matrices (``leaf_f``/``cand_f``/…)
+rather than one array per quantity: a split step updates 2 rows of 5
+matrices instead of ~30 scalars across ~25 arrays, because with 254
+sequential steps inside one XLA program the per-op floor (~3µs) — not
+FLOPs — dominates the bookkeeping cost.
 """
 
 from __future__ import annotations
@@ -37,17 +43,23 @@ from jax import lax
 from .binning import MISSING_NAN, MISSING_ZERO
 from .config import Config
 from .dataset import _ConstructedDataset
-from .learner import (NUM_REC_FIELDS, REC_DEFAULT_LEFT, REC_FEATURE, REC_GAIN,
-                      REC_INTERNAL_CNT, REC_INTERNAL_VALUE, REC_LEAF,
-                      REC_LEFT_CNT, REC_LEFT_OUT, REC_LEFT_SUM_G,
-                      REC_LEFT_SUM_H, REC_RIGHT_CNT, REC_RIGHT_OUT,
-                      REC_RIGHT_SUM_G, REC_RIGHT_SUM_H, REC_THRESHOLD,
-                      REC_VALID, TPUTreeLearner, _LeafCand)
+from .learner import (NUM_REC_FIELDS, REC_VALID, TPUTreeLearner, _FeatCand)
 from .ops.hist_pallas import (build_histogram_packed, pack_bin_words,
                               unpack_bin_words)
 from .ops.histogram import _on_tpu, build_histogram_onehot
-from .ops.split import SplitCandidates, find_best_splits
+from .ops.split import find_best_splits
 from .tree import Tree
+
+# fused per-leaf state columns (acc dtype)
+LF_SUM_G, LF_SUM_H, LF_CNT, LF_OUT, LF_DEPTH, LF_MIN_C, LF_MAX_C = range(7)
+NUM_LF = 7
+# fused per-leaf best-candidate columns (acc dtype)
+CF_GAIN, CF_LSG, CF_LSH, CF_LCNT, CF_RSG, CF_RSH, CF_RCNT, CF_LOUT, \
+    CF_ROUT = range(9)
+NUM_CF = 9
+# int candidate columns; flags bit0 = default_left, bit1 = is_cat
+CI_FEAT, CI_THR, CI_FLAGS = range(3)
+NUM_CI = 3
 
 
 class CompactState(NamedTuple):
@@ -55,21 +67,16 @@ class CompactState(NamedTuple):
     w_p: jax.Array         # (3, N) f32 — (g·bag, h·bag, bag), permuted
     rid_p: jax.Array       # (N,) int32 — original row id at each position
     lid_p: jax.Array       # (N,) int32 — leaf id at each position
-    leaf_start: jax.Array  # (L,) int32 — window start per leaf
-    leaf_wcnt: jax.Array   # (L,) int32 — window size (incl. out-of-bag/pad)
+    leaf_i: jax.Array      # (L, 2) int32 — [window start, window size]
+    leaf_f: jax.Array      # (L, NUM_LF) acc — sums/cnt/output/depth/bounds
     hist_pool: jax.Array   # (L, F, B, 3)
-    leaf_sum_g: jax.Array  # (L,)
-    leaf_sum_h: jax.Array
-    leaf_cnt: jax.Array    # (L,) bagged counts (histogram dtype)
-    leaf_output: jax.Array
-    leaf_depth: jax.Array
-    cand: _LeafCand        # per-leaf best splits, fields (L,)
+    cand_f: jax.Array      # (L, NUM_CF) acc — per-leaf best split floats
+    cand_i: jax.Array      # (L, NUM_CI) int32 — feature/threshold/flags
+    cand_b: jax.Array      # (L, W) uint32 — categorical bitsets
     num_leaves: jax.Array
     rec_f: jax.Array       # (L-1, NUM_REC_FIELDS) f32
     rec_i: jax.Array       # (L-1, 2) int32 — exact bagged left/right counts
     rec_cat: jax.Array     # (L-1, W) uint32 — bin bitset of cat splits
-    leaf_min_c: jax.Array  # (L,) monotone value constraints per leaf
-    leaf_max_c: jax.Array
 
 
 class CompactTPUTreeLearner(TPUTreeLearner):
@@ -105,6 +112,7 @@ class CompactTPUTreeLearner(TPUTreeLearner):
             raise ValueError(f"tpu_hist_precision must be one of "
                              f"{sorted(prec_map)}, got {cfg.tpu_hist_precision}")
         self._hist_nterms = prec_map[cfg.tpu_hist_precision]
+        self._acc = jnp.float64 if self.hist_dp else jnp.float32
         self._jit_tree_c = jax.jit(self._train_tree_compact)
 
     # -- packed bins ---------------------------------------------------------
@@ -117,6 +125,26 @@ class CompactTPUTreeLearner(TPUTreeLearner):
             self._bins_packed = packed
         return self._bins_packed
 
+    def _rows_len(self) -> int:
+        """Length of the row axis the window branches slice (the LOCAL
+        shard length under the sharded learner)."""
+        return self.n_pad
+
+    def _sync_counts(self, lc_bag, c_bag):
+        """Bagged split counts; the sharded learner psums local counts."""
+        return lc_bag, c_bag
+
+    def _reduce_hist(self, local_hist):
+        """Histogram exchange seam; the sharded learner reduce-scatters."""
+        return local_hist
+
+    def _child_best_rows(self, hist_left, hist_right, crow_f, feature_mask,
+                         depth_ok, constraints):
+        """Children's best-split rows; the sharded learner scans feature
+        slices and merges globally."""
+        return self._cand_rows_pair(hist_left, hist_right, crow_f,
+                                    feature_mask, depth_ok, constraints)
+
     # -- bucket helpers ------------------------------------------------------
 
     def _bucket_idx(self, cnt):
@@ -127,7 +155,7 @@ class CompactTPUTreeLearner(TPUTreeLearner):
 
     def _make_hist_branch(self, S: int):
         fw, f, b = self.fw, self.num_features, self.num_bins_padded
-        n = self.n_pad
+        n = self._rows_len()
 
         def branch(bins_p, w_p, start, cnt):
             sa = jnp.clip(start, 0, n - S).astype(jnp.int32)
@@ -150,7 +178,7 @@ class CompactTPUTreeLearner(TPUTreeLearner):
     # -- windowed stable partition ------------------------------------------
 
     def _make_partition_branch(self, S: int):
-        fw, n = self.fw, self.n_pad
+        fw, n = self.fw, self._rows_len()
 
         def branch(bins_p, w_p, rid_p, lid_p, s, c, feat, thr, dleft,
                    is_cat, cat_bits, new_leaf, do):
@@ -202,16 +230,38 @@ class CompactTPUTreeLearner(TPUTreeLearner):
 
         return branch
 
-    # -- per-leaf candidates -------------------------------------------------
+    # -- per-leaf candidates (packed rows) -----------------------------------
 
-    def _leaf_cands_pair(self, hist_l, hist_r, info, feature_mask,
-                         depth_ok, constraints=None
-                         ) -> Tuple[_LeafCand, _LeafCand]:
-        """Best splits for both children in one batched scan."""
+    def _pack_cand_rows(self, cands: _FeatCand, depth_ok):
+        """(K, F)-batched per-feature candidates → per-leaf best rows
+        ((K, NUM_CF) acc, (K, NUM_CI) int32, (K, W) uint32); argmax over
+        features with lowest index winning ties
+        (`serial_tree_learner.cpp:505-520`)."""
+        best_f = jnp.argmax(cands.gain, axis=1).astype(jnp.int32)   # (K,)
+        pick = lambda a: jnp.take_along_axis(a, best_f[:, None], axis=1)[:, 0]
+        gain = jnp.where(depth_ok, pick(cands.gain), -jnp.inf)
+        cf = jnp.stack([
+            gain.astype(self._acc),
+            pick(cands.left_sum_g), pick(cands.left_sum_h),
+            pick(cands.left_cnt),
+            pick(cands.right_sum_g), pick(cands.right_sum_h),
+            pick(cands.right_cnt),
+            pick(cands.left_output), pick(cands.right_output)],
+            axis=-1).astype(self._acc)
+        flags = pick(cands.default_left).astype(jnp.int32) \
+            + 2 * pick(cands.is_cat).astype(jnp.int32)
+        ci = jnp.stack([best_f, pick(cands.threshold), flags], axis=-1)
+        cb = jnp.take_along_axis(cands.cat_bits, best_f[:, None, None],
+                                 axis=1)[:, 0]
+        return cf, ci.astype(jnp.int32), cb
+
+    def _cand_rows_pair(self, hist_l, hist_r, crow_f, feature_mask,
+                        depth_ok, constraints=None):
+        """Best-split rows for both children in one batched scan."""
         hist2 = jnp.stack([hist_l, hist_r])
-        sg = jnp.stack([info.left_sum_g, info.right_sum_g])
-        sh = jnp.stack([info.left_sum_h, info.right_sum_h])
-        cn = jnp.stack([info.left_cnt, info.right_cnt])
+        sg = jnp.stack([crow_f[CF_LSG], crow_f[CF_RSG]])
+        sh = jnp.stack([crow_f[CF_LSH], crow_f[CF_RSH]])
+        cn = jnp.stack([crow_f[CF_LCNT], crow_f[CF_RCNT]])
 
         if constraints is not None:
             mins, maxs = constraints
@@ -224,99 +274,87 @@ class CompactTPUTreeLearner(TPUTreeLearner):
                 lambda h, g, hh, c: self._feature_cands(h, g, hh, c,
                                                         feature_mask)
             )(hist2, sg, sh, cn)
-
-        best_f = jnp.argmax(cands.gain, axis=1).astype(jnp.int32)  # (2,)
-        pick = lambda a: jnp.take_along_axis(a, best_f[:, None], axis=1)[:, 0]
-        pick_bits = lambda a: jnp.take_along_axis(
-            a, best_f[:, None, None], axis=1)[:, 0]
-        out = []
-        for i in range(2):
-            lc = _LeafCand(
-                gain=jnp.where(depth_ok, cands.gain[i, best_f[i]], -jnp.inf),
-                feature=best_f[i],
-                threshold=pick(cands.threshold)[i],
-                default_left=pick(cands.default_left)[i],
-                is_cat=pick(cands.is_cat)[i],
-                cat_bits=pick_bits(cands.cat_bits)[i],
-                left_sum_g=pick(cands.left_sum_g)[i],
-                left_sum_h=pick(cands.left_sum_h)[i],
-                left_cnt=pick(cands.left_cnt)[i],
-                right_sum_g=pick(cands.right_sum_g)[i],
-                right_sum_h=pick(cands.right_sum_h)[i],
-                right_cnt=pick(cands.right_cnt)[i],
-                left_output=pick(cands.left_output)[i],
-                right_output=pick(cands.right_output)[i])
-            out.append(lc)
-        return out[0], out[1]
+        return self._pack_cand_rows(cands, depth_ok)
 
     # -- root ----------------------------------------------------------------
 
     def _init_root_compact(self, grad, hess, bag, feature_mask) -> CompactState:
         n, f, b, L = self.n_pad, self.num_features, self.num_bins_padded, \
             self.num_leaves
+        acc = self._acc
         w = jnp.stack([grad * bag, hess * bag, bag], axis=0)
         bins_p = self.bins_packed()
         root_hist = self._hist_branches[-1](bins_p, w, jnp.int32(0),
                                             jnp.int32(n))
-        acc = jnp.float64 if self.hist_dp else jnp.float32
         sum_g = jnp.sum((grad * bag).astype(acc))
         sum_h = jnp.sum((hess * bag).astype(acc))
         cnt = jnp.sum(bag.astype(acc))
         md = int(self.cfg.max_depth)
-        depth_ok = jnp.asarray(True if md <= 0 else md > 0)
-        root = self._leaf_cand(root_hist, sum_g, sum_h, cnt, feature_mask,
-                               depth_ok)
+        depth_ok = jnp.asarray([True if md <= 0 else md > 0])
+        cands = jax.vmap(
+            lambda h, g, hh, c: self._feature_cands(h, g, hh, c, feature_mask)
+        )(root_hist[None], sum_g[None], sum_h[None], cnt[None])
+        cf_root, ci_root, cb_root = self._pack_cand_rows(cands, depth_ok)
 
-        def expand(x):
-            x = jnp.asarray(x)
-            return jnp.concatenate(
-                [x[None], jnp.zeros((L - 1,) + x.shape, x.dtype)], axis=0)
-
-        cand_L = jax.tree_util.tree_map(expand, root)
-        cand_L = cand_L._replace(gain=cand_L.gain.at[1:].set(-jnp.inf))
+        root_lf = jnp.asarray(
+            [0.0, 0.0, 0.0, 0.0, 0.0, -jnp.inf, jnp.inf], acc)
+        root_lf = root_lf.at[LF_SUM_G].set(sum_g).at[LF_SUM_H].set(sum_h) \
+                         .at[LF_CNT].set(cnt)
         return CompactState(
             bins_p=bins_p,
             w_p=w,
             rid_p=jnp.arange(n, dtype=jnp.int32),
             lid_p=jnp.zeros(n, jnp.int32),
-            leaf_start=jnp.zeros(L, jnp.int32),
-            leaf_wcnt=jnp.zeros(L, jnp.int32).at[0].set(n),
+            leaf_i=jnp.zeros((L, 2), jnp.int32).at[0, 1].set(n),
+            leaf_f=jnp.zeros((L, NUM_LF), acc)
+                      .at[:, LF_MIN_C].set(-jnp.inf)
+                      .at[:, LF_MAX_C].set(jnp.inf)
+                      .at[0].set(root_lf),
             hist_pool=jnp.zeros((L, f, b, 3), root_hist.dtype).at[0]
                          .set(root_hist),
-            leaf_sum_g=jnp.zeros(L, acc).at[0].set(sum_g),
-            leaf_sum_h=jnp.zeros(L, acc).at[0].set(sum_h),
-            leaf_cnt=jnp.zeros(L, acc).at[0].set(cnt),
-            leaf_output=jnp.zeros(L, jnp.float32),
-            leaf_depth=jnp.zeros(L, jnp.int32),
-            cand=cand_L,
+            cand_f=jnp.zeros((L, NUM_CF), acc)
+                      .at[:, CF_GAIN].set(-jnp.inf)
+                      .at[0].set(cf_root[0]),
+            cand_i=jnp.zeros((L, NUM_CI), jnp.int32).at[0].set(ci_root[0]),
+            cand_b=jnp.zeros((L, self.cat_W), jnp.uint32).at[0]
+                      .set(cb_root[0]),
             num_leaves=jnp.asarray(1, jnp.int32),
             rec_f=jnp.zeros((L - 1, NUM_REC_FIELDS), jnp.float32),
             rec_i=jnp.zeros((L - 1, 2), jnp.int32),
-            rec_cat=jnp.zeros((L - 1, self.cat_W), jnp.uint32),
-            leaf_min_c=jnp.full(L, -jnp.inf, jnp.float32),
-            leaf_max_c=jnp.full(L, jnp.inf, jnp.float32))
+            rec_cat=jnp.zeros((L - 1, self.cat_W), jnp.uint32))
 
     # -- one split -----------------------------------------------------------
 
     def _split_step_compact(self, state: CompactState, feature_mask,
                             step_idx) -> CompactState:
         cfg = self.cfg
-        cand = state.cand
-        best_leaf = jnp.argmax(cand.gain).astype(jnp.int32)
-        best_gain = cand.gain[best_leaf]
-        do = best_gain > 0.0
-        info = jax.tree_util.tree_map(lambda a: a[best_leaf], cand)
+        best_leaf = jnp.argmax(state.cand_f[:, CF_GAIN]).astype(jnp.int32)
         new_leaf = state.num_leaves
-        s = state.leaf_start[best_leaf]
-        c = state.leaf_wcnt[best_leaf]
+        idx2 = jnp.stack([best_leaf, new_leaf])
+
+        crow_f = state.cand_f[best_leaf]          # (NUM_CF,) acc
+        crow_i = state.cand_i[best_leaf]          # (NUM_CI,) int32
+        crow_b = state.cand_b[best_leaf]          # (W,) uint32
+        lrow_i = state.leaf_i[best_leaf]
+        lrow_f = state.leaf_f[best_leaf]
+
+        best_gain = crow_f[CF_GAIN]
+        do = best_gain > 0.0
+        feat = crow_i[CI_FEAT]
+        thr = crow_i[CI_THR]
+        dleft = (crow_i[CI_FLAGS] & 1) == 1
+        is_cat = (crow_i[CI_FLAGS] & 2) == 2
+        s = lrow_i[0]
+        c = lrow_i[1]
 
         # ---- partition the parent's window (DataPartition::Split)
         pidx = self._bucket_idx(c)
         bins_p, w_p, rid_p, lid_p, lc_w, lc_bag, c_bag = lax.switch(
             pidx, self._partition_branches, state.bins_p, state.w_p,
-            state.rid_p, state.lid_p, s, c, info.feature, info.threshold,
-            info.default_left, info.is_cat, info.cat_bits, new_leaf, do)
+            state.rid_p, state.lid_p, s, c, feat, thr, dleft, is_cat,
+            crow_b, new_leaf, do)
         rc_w = c - lc_w
+        lc_bag, c_bag = self._sync_counts(lc_bag, c_bag)
 
         # ---- smaller-child histogram + sibling subtraction
         # (`serial_tree_learner.cpp:371-385`); the smaller child is chosen by
@@ -326,89 +364,82 @@ class CompactTPUTreeLearner(TPUTreeLearner):
         small_start = jnp.where(left_smaller, s, s + lc_w)
         small_cnt = jnp.where(left_smaller, lc_w, rc_w)
         hidx = self._bucket_idx(jnp.maximum(small_cnt, 1))
-        hist_small = lax.switch(hidx, self._hist_branches, bins_p, w_p,
-                                small_start, small_cnt)
+        hist_small = self._reduce_hist(lax.switch(
+            hidx, self._hist_branches, bins_p, w_p, small_start, small_cnt))
         hist_parent = state.hist_pool[best_leaf]
         hist_large = hist_parent - hist_small
         hist_left = jnp.where(left_smaller, hist_small, hist_large)
         hist_right = jnp.where(left_smaller, hist_large, hist_small)
-        hist_pool = state.hist_pool
-        hist_pool = hist_pool.at[best_leaf].set(
-            jnp.where(do, hist_left, hist_parent))
-        hist_pool = hist_pool.at[new_leaf].set(
-            jnp.where(do, hist_right, hist_pool[new_leaf]))
 
-        # ---- leaf bookkeeping
-        upd = lambda arr, l_val, r_val: (
-            arr.at[best_leaf].set(jnp.where(do, l_val, arr[best_leaf]))
-               .at[new_leaf].set(jnp.where(do, r_val, arr[new_leaf])))
-        leaf_sum_g = upd(state.leaf_sum_g, info.left_sum_g, info.right_sum_g)
-        leaf_sum_h = upd(state.leaf_sum_h, info.left_sum_h, info.right_sum_h)
-        leaf_cnt = upd(state.leaf_cnt, info.left_cnt, info.right_cnt)
-        prev_output = state.leaf_output[best_leaf]
-        leaf_output = upd(state.leaf_output, info.left_output,
-                          info.right_output)
-        child_depth = state.leaf_depth[best_leaf] + 1
-        leaf_depth = upd(state.leaf_depth, child_depth, child_depth)
-        leaf_start = state.leaf_start.at[new_leaf].set(
-            jnp.where(do, s + lc_w, state.leaf_start[new_leaf]))
-        leaf_wcnt = upd(state.leaf_wcnt, lc_w, rc_w)
+        def upd2(arr, row_l, row_r):
+            """Write the two children's rows at [best_leaf, new_leaf] in one
+            scatter; exact no-op when the step is disabled."""
+            orig = arr[idx2]
+            rows = jnp.stack([row_l, row_r])
+            return arr.at[idx2].set(jnp.where(do, rows, orig))
+
+        hist_pool = upd2(state.hist_pool, hist_left, hist_right)
+
+        # ---- children bookkeeping rows
+        child_depth = lrow_f[LF_DEPTH] + 1.0
+        lout = crow_f[CF_LOUT]
+        rout = crow_f[CF_ROUT]
+        pmin = lrow_f[LF_MIN_C]
+        pmax = lrow_f[LF_MAX_C]
+        if self.has_monotone:
+            mono_t = jnp.where(is_cat, 0, self.f_monotone[feat])
+            mid = ((lout + rout) / 2.0).astype(self._acc)
+            lmin = jnp.where(mono_t < 0, mid, pmin)
+            lmax = jnp.where(mono_t > 0, mid, pmax)
+            rmin = jnp.where(mono_t > 0, mid, pmin)
+            rmax = jnp.where(mono_t < 0, mid, pmax)
+            constraints = (jnp.stack([lmin, rmin]), jnp.stack([lmax, rmax]))
+        else:
+            lmin = rmin = pmin
+            lmax = rmax = pmax
+            constraints = None
+        lf_l = jnp.stack([crow_f[CF_LSG], crow_f[CF_LSH], crow_f[CF_LCNT],
+                          lout, child_depth, lmin, lmax])
+        lf_r = jnp.stack([crow_f[CF_RSG], crow_f[CF_RSH], crow_f[CF_RCNT],
+                          rout, child_depth, rmin, rmax])
+        leaf_f = upd2(state.leaf_f, lf_l, lf_r)
+        leaf_i = upd2(
+            state.leaf_i,
+            jnp.stack([s, lc_w]).astype(jnp.int32),
+            jnp.stack([s + lc_w, rc_w]).astype(jnp.int32))
 
         # ---- children's best splits (with monotone constraint propagation)
         md = int(cfg.max_depth)
-        depth_ok = jnp.asarray(True) if md <= 0 else (child_depth < md)
-        if self.has_monotone:
-            pmin = state.leaf_min_c[best_leaf]
-            pmax = state.leaf_max_c[best_leaf]
-            lmin, lmax, rmin, rmax = self._child_constraints(info, pmin, pmax)
-            leaf_min_c = upd(state.leaf_min_c, lmin, rmin)
-            leaf_max_c = upd(state.leaf_max_c, lmax, rmax)
-            constraints = (jnp.stack([lmin, rmin]), jnp.stack([lmax, rmax]))
-        else:
-            leaf_min_c = state.leaf_min_c
-            leaf_max_c = state.leaf_max_c
-            constraints = None
-        cand_left, cand_right = self._leaf_cands_pair(
-            hist_left, hist_right, info, feature_mask, depth_ok, constraints)
+        depth_ok = jnp.asarray([True, True]) if md <= 0 \
+            else jnp.stack([child_depth < md] * 2)
+        cf_rows, ci_rows, cb_rows = self._child_best_rows(
+            hist_left, hist_right, crow_f, feature_mask, depth_ok,
+            constraints)
+        cand_f = upd2(state.cand_f, cf_rows[0], cf_rows[1])
+        cand_i = upd2(state.cand_i, ci_rows[0], ci_rows[1])
+        cand_b = upd2(state.cand_b, cb_rows[0], cb_rows[1])
 
-        def upd_cand(arr, l_val, r_val):
-            return (arr.at[best_leaf].set(jnp.where(do, l_val, arr[best_leaf]))
-                       .at[new_leaf].set(jnp.where(do, r_val, arr[new_leaf])))
-
-        new_cand = jax.tree_util.tree_map(upd_cand, state.cand, cand_left,
-                                          cand_right)
-
-        # ---- record for host-side tree assembly
-        # field order matches REC_* (= range(16))
+        # ---- record for host-side tree assembly (field order = REC_*)
         rec = jnp.stack([
-            do.astype(jnp.float32), best_leaf.astype(jnp.float32),
-            info.feature.astype(jnp.float32),
-            info.threshold.astype(jnp.float32),
-            info.default_left.astype(jnp.float32),
-            best_gain.astype(jnp.float32), info.left_output.astype(jnp.float32),
-            info.right_output.astype(jnp.float32),
-            info.left_cnt.astype(jnp.float32),
-            info.right_cnt.astype(jnp.float32),
-            prev_output.astype(jnp.float32),
-            state.leaf_cnt[best_leaf].astype(jnp.float32),
-            info.left_sum_h.astype(jnp.float32),
-            info.right_sum_h.astype(jnp.float32),
-            info.left_sum_g.astype(jnp.float32),
-            info.right_sum_g.astype(jnp.float32),
-            info.is_cat.astype(jnp.float32)])
+            do.astype(self._acc), best_leaf.astype(self._acc),
+            feat.astype(self._acc), thr.astype(self._acc),
+            dleft.astype(self._acc), best_gain,
+            lout, rout, crow_f[CF_LCNT], crow_f[CF_RCNT],
+            lrow_f[LF_OUT], lrow_f[LF_CNT],
+            crow_f[CF_LSH], crow_f[CF_RSH],
+            crow_f[CF_LSG], crow_f[CF_RSG],
+            is_cat.astype(self._acc)]).astype(jnp.float32)
         rec_f = state.rec_f.at[step_idx].set(rec)
         rec_i = state.rec_i.at[step_idx].set(
             jnp.stack([lc_bag, c_bag - lc_bag]).astype(jnp.int32))
-        rec_cat = state.rec_cat.at[step_idx].set(info.cat_bits)
+        rec_cat = state.rec_cat.at[step_idx].set(crow_b)
 
         return CompactState(
             bins_p=bins_p, w_p=w_p, rid_p=rid_p, lid_p=lid_p,
-            leaf_start=leaf_start, leaf_wcnt=leaf_wcnt, hist_pool=hist_pool,
-            leaf_sum_g=leaf_sum_g, leaf_sum_h=leaf_sum_h, leaf_cnt=leaf_cnt,
-            leaf_output=leaf_output, leaf_depth=leaf_depth, cand=new_cand,
+            leaf_i=leaf_i, leaf_f=leaf_f, hist_pool=hist_pool,
+            cand_f=cand_f, cand_i=cand_i, cand_b=cand_b,
             num_leaves=state.num_leaves + do.astype(jnp.int32),
-            rec_f=rec_f, rec_i=rec_i, rec_cat=rec_cat,
-            leaf_min_c=leaf_min_c, leaf_max_c=leaf_max_c)
+            rec_f=rec_f, rec_i=rec_i, rec_cat=rec_cat)
 
     # -- whole tree ----------------------------------------------------------
 
@@ -426,8 +457,9 @@ class CompactTPUTreeLearner(TPUTreeLearner):
         # leaf partition in ORIGINAL row order for the score updater
         leaf_id = jnp.zeros(self.n_pad, jnp.int32).at[state.rid_p].set(
             state.lid_p)
+        leaf_output = state.leaf_f[:, LF_OUT].astype(jnp.float32)
         return (state.rec_f, state.rec_i, state.rec_cat, leaf_id,
-                state.leaf_output)
+                leaf_output)
 
     # -- host orchestration --------------------------------------------------
 

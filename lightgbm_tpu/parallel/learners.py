@@ -46,11 +46,26 @@ def apply_parallel_sharding(gbdt, mesh: Mesh, mode: str) -> None:
     if hasattr(gbdt, "_flush_pending"):
         gbdt._flush_pending()
     learner = gbdt.learner
+    mesh_size = max(int(np.prod(mesh.devices.shape)), 1)
+    if mode in ("data", "voting") and learner.data.max_num_bin <= 256 \
+            and learner.data.num_data_padded % mesh_size == 0 \
+            and learner.data.bins.shape[0] % mesh_size == 0:
+        # the real distributed path: per-shard compact learner with
+        # reduce-scattered histograms; voting adds PV-Tree feature election
+        # (`compact_sharded.py`)
+        from .compact_sharded import (ShardedCompactLearner,
+                                      ShardedVotingLearner)
+        cls = ShardedVotingLearner if mode == "voting" \
+            else ShardedCompactLearner
+        gbdt.learner = cls(learner.cfg, learner.data, mesh)
+        _place_row_arrays(gbdt, mesh, mode)
+        gbdt._mesh = mesh
+        gbdt._parallel_mode = mode
+        return
     if type(learner) is not TPUTreeLearner:
-        # the compact learner keeps its own packed-bin cache and global-axis
-        # sort — the sharding mutations below would be silently ignored;
-        # transparently swap in the masked learner (the same routing
-        # `create_tree_learner` applies for parallel modes)
+        # feature-parallel / >256-bin fallbacks drape GSPMD over the masked
+        # learner — the compact learner's packed-bin cache and global-axis
+        # sort would silently ignore the sharding mutations below
         learner = TPUTreeLearner(learner.cfg, learner.data,
                                  learner.hist_backend)
         gbdt.learner = learner
@@ -95,6 +110,30 @@ def apply_parallel_sharding(gbdt, mesh: Mesh, mode: str) -> None:
                     pass
     gbdt._mesh = mesh
     gbdt._parallel_mode = mode
+
+
+def _place_row_arrays(gbdt, mesh: Mesh, mode: str) -> None:
+    """Shard the boosting loop's row-aligned arrays (score, bagging mask,
+    objective label arrays) over the mesh's row axis."""
+    axis = mesh.axis_names[0]
+    row_spec = P(axis)
+    put = lambda arr, spec: jax.device_put(arr, NamedSharding(mesh, spec))
+    gbdt._valid_rows = put(gbdt._valid_rows, row_spec)
+    gbdt._bag_mask = put(gbdt._bag_mask, row_spec)
+    gbdt.train_score.score = put(gbdt.train_score.score, P(None, axis))
+    obj = gbdt.objective
+    if obj is not None:
+        for name in ("label", "weights", "trans_label", "label_sign",
+                     "label_w", "label_weight", "label_onehot"):
+            arr = getattr(obj, name, None)
+            if arr is not None and hasattr(arr, "shape") and arr.ndim >= 1:
+                spec = row_spec if arr.ndim == 1 else P(None, axis)
+                try:
+                    setattr(obj, name, put(arr, spec))
+                except Exception as e:
+                    import warnings
+                    warnings.warn(f"could not shard objective array "
+                                  f"{name!r} over the mesh: {e}")
 
 
 def make_data_parallel(gbdt, num_devices: Optional[int] = None) -> Mesh:

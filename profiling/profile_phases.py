@@ -90,6 +90,7 @@ def main():
         pb = jax.jit(lrn._partition_branches[i])
         t = timeit(pb, bins_p, w, rid, lid, jnp.int32(0), jnp.int32(S),
                    jnp.int32(3), jnp.int32(100), jnp.asarray(True),
+                   jnp.asarray(False), jnp.zeros(lrn.cat_W, jnp.uint32),
                    jnp.int32(1), jnp.asarray(True))
         part_t[S] = t
     out["hist_by_window_s"] = {str(k): v for k, v in hist_t.items()}
@@ -98,12 +99,11 @@ def main():
     # -- split scan (pair of children) ---------------------------------------
     hist = jnp.abs(jnp.asarray(
         rng.randn(lrn.num_features, lrn.num_bins_padded, 3), jnp.float32))
-    from lightgbm_tpu.learner import _LeafCand  # noqa
-    info_like = lrn._leaf_cand(hist, jnp.float32(0.0), jnp.float32(rows / 4),
-                               jnp.float32(rows), fmask, jnp.asarray(True))
-    pair = jax.jit(lambda hl, hr, inf: lrn._leaf_cands_pair(
-        hl, hr, inf, fmask, jnp.asarray(True)))
-    t = timeit(pair, hist, hist * 0.5, info_like)
+    crow = jnp.asarray([1.0, 0.0, rows / 8, rows / 2, 0.0, rows / 8,
+                        rows / 2, 0.0, 0.0], jnp.float32)
+    pair = jax.jit(lambda hl, hr, cr: lrn._cand_rows_pair(
+        hl, hr, cr, fmask, jnp.asarray([True, True])))
+    t = timeit(pair, hist, hist * 0.5, crow)
     out["split_scan_pair_s"] = t
 
     # -- model: expected per-tree totals --------------------------------------

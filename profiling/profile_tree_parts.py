@@ -80,19 +80,15 @@ def main():
             return branch
 
     class NoScan(CompactTPUTreeLearner):
-        def _leaf_cands_pair(self, hist_l, hist_r, info, feature_mask,
-                             depth_ok, constraints=None):
-            from lightgbm_tpu.learner import _LeafCand
+        def _cand_rows_pair(self, hist_l, hist_r, crow_f, feature_mask,
+                            depth_ok, constraints=None):
             z = hist_l[0, 0, 0] * 0.0
-            mk = lambda: _LeafCand(
-                gain=z + 1.0, feature=jnp.int32(1) + z.astype(jnp.int32),
-                threshold=jnp.int32(10), default_left=jnp.asarray(False),
-                is_cat=jnp.asarray(False),
-                cat_bits=jnp.zeros(self.cat_W, jnp.uint32),
-                left_sum_g=z, left_sum_h=z + 100.0, left_cnt=z + 50.0,
-                right_sum_g=z, right_sum_h=z + 100.0, right_cnt=z + 50.0,
-                left_output=z, right_output=z)
-            return mk(), mk()
+            cf = jnp.tile(jnp.asarray(
+                [1.0, 0.0, 100.0, 50.0, 0.0, 100.0, 50.0, 0.0, 0.0],
+                self._acc), (2, 1)) + z.astype(self._acc)
+            ci = jnp.tile(jnp.asarray([1, 10, 0], jnp.int32), (2, 1))
+            cb = jnp.zeros((2, self.cat_W), jnp.uint32)
+            return cf, ci, cb
 
     n_pad = data.num_data_padded
     grad = jnp.asarray(rng.randn(n_pad).astype(np.float32))
