@@ -381,6 +381,34 @@ class BinMapper:
         out = np.where((iv < 0) | (iv > lut_max), self.num_bin - 1, lut[np.clip(iv, 0, lut_max)])
         return out.astype(np.int32)
 
+    def values_to_bins_predict(self, values: np.ndarray,
+                               oov_bin: int) -> np.ndarray:
+        """Binning with RAW-prediction semantics for categorical features
+        (``Tree::CategoricalDecision``, `tree.h:250-268`): unseen or
+        negative categories map to ``oov_bin`` (beyond every split bitset →
+        always right), and NaN maps to the NaN bin under missing_type NaN
+        (never inside a bitset — ``used_bin`` excludes it) or to category
+        0's bin otherwise.  Numerical features bin normally (thresholds are
+        bin upper bounds, so raw and binned compares agree exactly)."""
+        if self.bin_type == BIN_NUMERICAL:
+            return self.values_to_bins(values)
+        values = np.asarray(values, dtype=np.float64)
+        nan_mask = np.isnan(values)
+        iv = np.where(nan_mask, 0, values).astype(np.int64)
+        lut_max = max(self.categorical_2_bin.keys(), default=0)
+        lut = np.full(lut_max + 2, oov_bin, dtype=np.int32)
+        for cat, b in self.categorical_2_bin.items():
+            if cat >= 0:
+                lut[cat] = b
+        out = np.where((iv < 0) | (iv > lut_max), oov_bin,
+                       lut[np.clip(iv, 0, lut_max)])
+        if self.missing_type == MISSING_NAN:
+            # raw categorical prediction always sends NaN right
+            # (`tree.h:255-258`) — the sentinel guarantees that even when a
+            # truncated vocabulary left no dedicated NaN bin
+            out = np.where(nan_mask, oov_bin, out)
+        return out.astype(np.int32)
+
     def bin_to_value(self, bin_idx: int) -> float:
         """Representative value for a bin (used in model text thresholds)."""
         if self.bin_type == BIN_NUMERICAL:

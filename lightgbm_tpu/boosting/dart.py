@@ -74,6 +74,7 @@ class DART(GBDT):
                 cfg.learning_rate / (cfg.learning_rate + n_drop)
 
     def train_one_iter(self, gradients=None, hessians=None) -> bool:
+        self._model_version += 1   # drops/normalize mutate old trees in place
         self._dropping_trees()
         ret = super().train_one_iter(gradients, hessians)
         if ret:

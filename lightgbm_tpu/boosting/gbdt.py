@@ -245,6 +245,8 @@ class GBDT:
         self.iter_ = 0
         self._pending: List[tuple] = []
         self._stopped = False
+        self._model_version = 0          # bumped on in-place tree mutation
+        self._device_predictor = None    # (key, DevicePredictor) cache
         self._jit_grad_fn = None
         self._lr_dev = None
         self._lr_dev_val = None
@@ -416,6 +418,13 @@ class GBDT:
             self._np_bag_mask = mask
             self._bag_cnt = bag_cnt
 
+    def _np_bag(self) -> np.ndarray:
+        """Host copy of the bagging mask, materialized lazily (device-side
+        samplers like GOSS leave it None until a renew path needs it)."""
+        if self._np_bag_mask is None:
+            self._np_bag_mask = np.asarray(self._bag_mask)
+        return self._np_bag_mask
+
     def _feature_sample(self) -> jax.Array:
         """Per-tree feature_fraction sampling (`serial_tree_learner.cpp:255-283`)."""
         f = self.train_data.num_used_features
@@ -533,7 +542,7 @@ class GBDT:
                     score_np = np.asarray(self.train_score.score[k])
                     self.objective.renew_tree_output(
                         new_tree, score_np[:self.num_data],
-                        leaf_id, self._np_bag_mask)
+                        leaf_id, self._np_bag())
                 new_tree.apply_shrinkage(self.shrinkage_rate)
                 self.train_score.add_by_leaf_id(
                     new_tree.leaf_value[:new_tree.num_leaves], leaf_id, k)
@@ -670,8 +679,27 @@ class GBDT:
         X = np.ascontiguousarray(X, dtype=np.float64)
         n = X.shape[0]
         k = self.num_tree_per_iteration
-        out = np.zeros((n, k), dtype=np.float64)
         num_models = self._num_models_for(num_iteration)
+        cfg = self.cfg
+        # device batch predictor (`predictor.py`): exact bin-space traversal
+        # of all trees in one scan — needs the training mappers; text-loaded
+        # boosters without a bound dataset use the host path below
+        use_device = (self.train_data is not None and num_models > 0
+                      and (n * num_models >= 200_000 or cfg.pred_early_stop))
+        if use_device:
+            from ..predictor import DevicePredictor
+            key = (num_models, self._model_version, cfg.pred_early_stop,
+                   cfg.pred_early_stop_freq, cfg.pred_early_stop_margin)
+            if self._device_predictor is None \
+                    or self._device_predictor[0] != key:
+                self._device_predictor = (key, DevicePredictor(
+                    self, self.train_data, num_iteration,
+                    pred_early_stop=cfg.pred_early_stop,
+                    pred_early_stop_freq=cfg.pred_early_stop_freq,
+                    pred_early_stop_margin=cfg.pred_early_stop_margin))
+            out = self._device_predictor[1].predict_raw(X)
+            return out.astype(np.float64)
+        out = np.zeros((n, k), dtype=np.float64)
         for i in range(num_models):
             out[:, i % k] += self.models[i].predict(X)
         return out[:, 0] if k == 1 else out
@@ -703,6 +731,7 @@ class GBDT:
         score contribution."""
         if self.iter_ <= 0:
             return
+        self._model_version += 1
         for k in range(self.num_tree_per_iteration):
             idx = len(self.models) - self.num_tree_per_iteration + k
             tree = self.models[idx]
@@ -803,6 +832,7 @@ class GBDT:
         data: per iteration, gradients at the running score, per-leaf
         grad/hess sums, ``decay·old + (1-decay)·new·shrinkage``."""
         models = self.models  # flush pending
+        self._model_version += 1
         k = max(self.num_tree_per_iteration, 1)
         n = self.num_data
         assert leaf_preds.shape == (n, len(models)), \

@@ -3,18 +3,44 @@
 Keep the top ``top_rate`` fraction of rows by |grad·hess|, sample
 ``other_rate`` of the rest uniformly and amplify their gradients by
 ``(1-top_rate)/other_rate`` so histogram sums stay unbiased.  The reference
-builds an index subset; here sampling is a device-side mask and the
-amplification is folded into the gradients before tree construction — the
-cnt histogram channel still counts real rows because the bagging mask stays
-0/1.
+builds an index subset on the host; here the whole selection is ONE jitted
+device computation (threshold from a device sort, uniform sampling from a
+fold_in'd PRNG key, amplification normalized by the ACTUAL sampled count) —
+no per-iteration host round trip, so GOSS pipelines like plain GBDT.
 """
 
 from __future__ import annotations
 
+import functools
+
 import numpy as np
+import jax
 import jax.numpy as jnp
 
 from .gbdt import GBDT
+
+
+@functools.partial(jax.jit, static_argnames=("top_k", "other_k"))
+def _goss_select(grad, hess, valid_rows, key, *, top_k: int, other_k: int):
+    """Device GOSS sampling: returns (bag mask f32, per-row amplification)."""
+    mag = jnp.sum(jnp.abs(grad * hess), axis=0)
+    neg_inf = jnp.float32(-jnp.inf)
+    magv = jnp.where(valid_rows > 0.5, mag, neg_inf)
+    # exact top_k membership by magnitude (a threshold cut would evict
+    # strictly-larger rows on ties)
+    vals, idx = jax.lax.top_k(magv, top_k)
+    is_top = jnp.zeros(mag.shape, bool).at[idx].set(
+        ~jnp.isneginf(vals), mode="drop")
+    rest = (valid_rows > 0.5) & ~is_top
+    n_rest = jnp.sum(rest.astype(jnp.int32))
+    p = jnp.minimum(other_k / jnp.maximum(n_rest, 1), 1.0)
+    u = jax.random.uniform(key, mag.shape)
+    sampled = rest & (u < p)
+    n_samp = jnp.maximum(jnp.sum(sampled.astype(jnp.int32)), 1)
+    multiply = n_rest.astype(jnp.float32) / n_samp.astype(jnp.float32)
+    bag = (is_top | sampled).astype(jnp.float32)
+    amp = jnp.where(sampled, multiply, 1.0).astype(jnp.float32)
+    return bag, amp
 
 
 class GOSS(GBDT):
@@ -29,8 +55,7 @@ class GOSS(GBDT):
         if cfg.bagging_freq > 0 and cfg.bagging_fraction != 1.0:
             raise ValueError("Cannot use bagging in GOSS")
         super().init(train_data, objective, training_metrics)
-        self._goss_rng = np.random.RandomState(cfg.bagging_seed)
-        self._amplified = None
+        self._goss_key = jax.random.PRNGKey(cfg.bagging_seed)
 
     def _bagging(self, iter_):  # sampling handled in train_one_iter
         pass
@@ -51,29 +76,17 @@ class GOSS(GBDT):
         # not subsampled for the first 1/learning_rate iterations
         # (`goss.hpp:139-141`)
         if self.iter_ >= int(1.0 / cfg.learning_rate):
-            mag = jnp.sum(jnp.abs(grad * hess), axis=0)
-            mag = np.asarray(mag)[:n]
             top_k = max(1, int(n * cfg.top_rate))
             other_k = max(1, int(n * cfg.other_rate))
-            order = np.argsort(-mag, kind="stable")
-            top_idx = order[:top_k]
-            rest_idx = order[top_k:]
-            sampled = self._goss_rng.choice(
-                len(rest_idx), min(other_k, len(rest_idx)), replace=False)
-            other_idx = rest_idx[sampled]
-            multiply = (n - top_k) / other_k
-            mask = np.zeros(self.train_data.num_data_padded, dtype=np.float32)
-            mask[top_idx] = 1.0
-            mask[other_idx] = 1.0
-            amp = np.ones(self.train_data.num_data_padded, dtype=np.float32)
-            amp[other_idx] = multiply
-            self._bag_mask = self._place_rows(mask)
-            self._np_bag_mask = mask
-            amp_d = self._place_rows(amp)[None, :]
-            grad = grad * amp_d
-            hess = hess * amp_d
+            key = jax.random.fold_in(self._goss_key, self.iter_)
+            bag, amp = _goss_select(grad, hess, self._valid_rows, key,
+                                    top_k=top_k, other_k=other_k)
+            self._bag_mask = bag
+            self._np_bag_mask = None   # materialized lazily (renew path)
+            grad = grad * amp[None, :]
+            hess = hess * amp[None, :]
         else:
             self._bag_mask = self._valid_rows
-            self._np_bag_mask = np.asarray(self._valid_rows)
+            self._np_bag_mask = None
 
         return self._train_trees(grad, hess, init_scores)

@@ -72,7 +72,7 @@ class RF(GBDT):
                     const_score = np.full(self.num_data,
                                           self.init_scores[k], dtype=np.float64)
                     self.objective.renew_tree_output(
-                        new_tree, const_score, leaf_id, self._np_bag_mask)
+                        new_tree, const_score, leaf_id, self._np_bag())
                 if abs(self.init_scores[k]) > kEpsilon:
                     new_tree.leaf_value[:new_tree.num_leaves] += self.init_scores[k]
                 # running average of tree outputs (`rf.hpp:131-134`)

@@ -322,6 +322,9 @@ class Config:
     # bits, two MXU passes), "bf16x3" (~24 bits, three passes), or "highest"
     # (full f32 emulation, ~6 passes) for validation runs
     tpu_hist_precision: str = "bf16x2"
+    # windows at or below this size stop physically compacting (mask-mode
+    # partitions): small bitonic sorts are pure stage latency on TPU
+    tpu_sort_cutoff: int = 2048
 
     # derived (not user-settable)
     is_parallel: bool = field(default=False, repr=False)

@@ -446,12 +446,13 @@ class _ConstructedDataset:
         self.metadata = Metadata(n)
         self.bin_mappers = ref.bin_mappers
         self.used_feature_map = ref.used_feature_map
-        self._bin_all(mat, cfg)
+        self._bin_all(mat, cfg, is_reference_linked=True)
         return self
 
     FEATURE_TILE = 8  # feature-axis padding multiple for the Pallas kernel
 
-    def _bin_all(self, mat: np.ndarray, cfg: Config) -> None:
+    def _bin_all(self, mat: np.ndarray, cfg: Config,
+                 is_reference_linked: bool = False) -> None:
         n = self.num_data
         block = max(int(cfg.tpu_row_block), 128)
         self.num_data_padded = _round_up(max(n, 1), block)
@@ -463,6 +464,17 @@ class _ConstructedDataset:
         for k, m in enumerate(self.bin_mappers):
             j = int(self.used_feature_map[k])
             self.bins[k, :n] = m.values_to_bins(mat[:, j]).astype(dtype)
+        self.bundle = None
+        # bundles are consumed only by the TRAINING learner — valid sets
+        # (reference-linked) skip the exclusivity scan entirely
+        if not is_reference_linked \
+                and cfg.enable_bundle and cfg.tree_learner == "serial" \
+                and cfg.tpu_learner in ("auto", "compact") \
+                and self.max_num_bin <= 256 and fu > 1:
+            from .efb import find_bundles, apply_bundles
+            groups = find_bundles(self, cfg)
+            if any(len(g) > 1 for g in groups):
+                self.bundle = apply_bundles(self, groups)
 
     # -- binary cache format -------------------------------------------------
 

@@ -88,7 +88,31 @@ class CompactTPUTreeLearner(TPUTreeLearner):
                  hist_backend: str = "auto"):
         super().__init__(cfg, data, hist_backend)
         self.n_pad = int(data.num_data_padded)
-        f_pad = data.bins.shape[0]           # padded to a multiple of 8
+        # EFB: histograms and the device row payload live in BUNDLE columns
+        # (`efb.py`); the per-feature view is reconstructed at scan time
+        self._bundle = getattr(data, "bundle", None) \
+            if type(self) is CompactTPUTreeLearner else None
+        if self._bundle is not None:
+            bu = self._bundle
+            from .dataset import _round_up
+            g_pad = _round_up(bu.num_groups, data.FEATURE_TILE)
+            self._hist_cols = bu.num_groups
+            self._hist_nbins = int(max(self.num_bins_padded,
+                                       bu.max_group_bin))
+            f_pad = g_pad
+            idx, valid, fix = bu.unbundle_maps(
+                self.num_features, self.num_bins_padded, self._hist_nbins,
+                self.np_num_bin)
+            self._ub_idx = jnp.asarray(idx)
+            self._ub_valid = jnp.asarray(valid)
+            self._ub_fix = jnp.asarray(fix)
+            self.f_gcol = jnp.asarray(bu.f_gcol)
+            self.f_goff = jnp.asarray(bu.f_off)
+            self.f_bundled = jnp.asarray(bu.f_bundled)
+        else:
+            f_pad = data.bins.shape[0]       # padded to a multiple of 8
+            self._hist_cols = self.num_features
+            self._hist_nbins = self.num_bins_padded
         assert f_pad % 4 == 0, f_pad
         self.fw = f_pad // 4
         self._bins_packed = None             # packed device array, lazy
@@ -112,6 +136,7 @@ class CompactTPUTreeLearner(TPUTreeLearner):
             raise ValueError(f"tpu_hist_precision must be one of "
                              f"{sorted(prec_map)}, got {cfg.tpu_hist_precision}")
         self._hist_nterms = prec_map[cfg.tpu_hist_precision]
+        self._sort_cutoff = int(cfg.tpu_sort_cutoff)
         self._acc = jnp.float64 if self.hist_dp else jnp.float32
         self._jit_tree_c = jax.jit(self._train_tree_compact)
 
@@ -119,7 +144,11 @@ class CompactTPUTreeLearner(TPUTreeLearner):
 
     def bins_packed(self) -> jax.Array:
         if self._bins_packed is None:
-            packed = pack_bin_words(self.data.device_bins())
+            if self._bundle is not None:
+                src = jnp.asarray(self._bundle.encode(self.data))
+            else:
+                src = self.data.device_bins()
+            packed = pack_bin_words(src)
             if isinstance(packed, jax.core.Tracer):
                 return packed  # called under trace — don't cache the tracer
             self._bins_packed = packed
@@ -154,16 +183,20 @@ class CompactTPUTreeLearner(TPUTreeLearner):
     # -- windowed histogram --------------------------------------------------
 
     def _make_hist_branch(self, S: int):
-        fw, f, b = self.fw, self.num_features, self.num_bins_padded
+        fw, f, b = self.fw, self._hist_cols, self._hist_nbins
         n = self._rows_len()
 
-        def branch(bins_p, w_p, start, cnt):
+        def branch(bins_p, w_p, lid_p, start, cnt, leaf):
             sa = jnp.clip(start, 0, n - S).astype(jnp.int32)
             off = (start - sa).astype(jnp.int32)
             bw = lax.dynamic_slice(bins_p, (jnp.int32(0), sa), (fw, S))
             ww = lax.dynamic_slice(w_p, (jnp.int32(0), sa), (3, S))
+            lid = lax.dynamic_slice(lid_p, (sa,), (S,))
             pos = jnp.arange(S, dtype=jnp.int32)
-            m = ((pos >= off) & (pos < off + cnt))
+            # leaf-id equality folds in the mask-mode bottom of the tree,
+            # where windows are frozen and a leaf's rows are scattered
+            # within its ancestor's window
+            m = (pos >= off) & (pos < off + cnt) & (lid == leaf)
             wm = ww * m[None, :].astype(ww.dtype)
             if self._use_pallas:
                 h = build_histogram_packed(bw, wm, num_bins=b,
@@ -175,26 +208,50 @@ class CompactTPUTreeLearner(TPUTreeLearner):
 
         return branch
 
-    # -- windowed stable partition ------------------------------------------
+    # -- windowed partition --------------------------------------------------
 
-    def _make_partition_branch(self, S: int):
+    def _make_partition_branch(self, S: int, sort_mode: bool):
+        """One bucket's ``DataPartition::Split``.
+
+        sort_mode=True (windows above ``tpu_sort_cutoff``): a stable
+        one-bit-key lax.sort physically compacts the two children into
+        adjacent windows.  sort_mode=False (the bottom of the tree): the
+        window is FROZEN — only the leaf-id lane is rewritten elementwise
+        and both children inherit the parent's window; histogram masking by
+        leaf id replaces physical compaction.  Bitonic sorts at small sizes
+        are all fixed stage latency, so skipping them wins even though
+        bottom histograms then scan the frozen (larger) window.
+        Returns (bins_p, w_p, rid_p, lid_p, ls, lw, rs, rw, lc_bag, c_bag).
+        """
         fw, n = self.fw, self._rows_len()
 
-        def branch(bins_p, w_p, rid_p, lid_p, s, c, feat, thr, dleft,
+        def branch(bins_p, w_p, rid_p, lid_p, s, c, leaf, feat, thr, dleft,
                    is_cat, cat_bits, new_leaf, do):
             sa = jnp.clip(s, 0, n - S).astype(jnp.int32)
             off = (s - sa).astype(jnp.int32)
             bw = lax.dynamic_slice(bins_p, (jnp.int32(0), sa), (fw, S))
             ww = lax.dynamic_slice(w_p, (jnp.int32(0), sa), (3, S))
-            rid = lax.dynamic_slice(rid_p, (sa,), (S,))
             lid = lax.dynamic_slice(lid_p, (sa,), (S,))
             pos = jnp.arange(S, dtype=jnp.int32)
-            in_seg = (pos >= off) & (pos < off + c)
+            in_seg = (pos >= off) & (pos < off + c) & (lid == leaf)
             # decision on the split feature (NumericalDecisionInner,
             # `tree.h:233-249`; CategoricalDecisionInner `tree.h:270-277`)
-            # — unpack the one feature from its word
-            word = lax.dynamic_slice(bw, (feat // 4, jnp.int32(0)), (1, S))[0]
-            frow = (word >> ((feat % 4) * 8)) & 0xFF
+            # — unpack the one feature's (or its bundle's) byte lane
+            col = self.f_gcol[feat] if self._bundle is not None else feat
+            word = lax.dynamic_slice(bw, (col // 4, jnp.int32(0)), (1, S))[0]
+            code = (word >> ((col % 4) * 8)) & 0xFF
+            if self._bundle is not None:
+                # bundle code → this feature's bin (out-of-range codes mean
+                # another member was active → this feature sits at default)
+                boff = self.f_goff[feat]
+                d = self.f_default_bin[feat]
+                r = code - boff
+                in_r = (r >= 0) & (r < self.f_num_bin[feat] - 1)
+                dec = r + (r >= d).astype(r.dtype)
+                frow = jnp.where(self.f_bundled[feat],
+                                 jnp.where(in_r, dec, d), code)
+            else:
+                frow = code
             mt = self.f_missing[feat]
             db = self.f_default_bin[feat]
             nb = self.f_num_bin[feat]
@@ -205,30 +262,66 @@ class CompactTPUTreeLearner(TPUTreeLearner):
                 cat_left = (cat_bits[frow >> 5]
                             >> (frow & 31).astype(jnp.uint32)) & 1
                 go_left = jnp.where(is_cat, cat_left.astype(bool), go_left)
-            key = jnp.where(in_seg,
-                            jnp.where(go_left, 1, 2),
-                            jnp.where(pos < off, 0, 3)).astype(jnp.int32)
-            key = jnp.where(do, key, 0)
-            ops = ([key] + [bw[i] for i in range(fw)]
-                   + [ww[0], ww[1], ww[2], rid, lid])
-            sd = lax.sort(ops, num_keys=1, is_stable=True)
-            bw2 = jnp.stack(sd[1:1 + fw])
-            ww2 = jnp.stack(sd[1 + fw:4 + fw])
-            rid2, lid2 = sd[4 + fw], sd[5 + fw]
             segl = in_seg & go_left
-            lc_w = jnp.sum(segl.astype(jnp.int32))
             bag = ww[2] > 0.5
-            lc_bag = jnp.sum((segl & bag).astype(jnp.int32))
-            c_bag = jnp.sum((in_seg & bag).astype(jnp.int32))
-            in_right = (pos >= off + lc_w) & (pos < off + c)
-            lid2 = jnp.where(do & in_right, new_leaf, lid2)
-            bins_p = lax.dynamic_update_slice(bins_p, bw2, (jnp.int32(0), sa))
-            w_p = lax.dynamic_update_slice(w_p, ww2, (jnp.int32(0), sa))
-            rid_p = lax.dynamic_update_slice(rid_p, rid2, (sa,))
-            lid_p = lax.dynamic_update_slice(lid_p, lid2, (sa,))
-            return bins_p, w_p, rid_p, lid_p, lc_w, lc_bag, c_bag
+            lc_bag = jnp.sum((segl & bag).astype(jnp.int32)).astype(jnp.int32)
+            c_bag = jnp.sum((in_seg & bag).astype(jnp.int32)).astype(jnp.int32)
+
+            if sort_mode:
+                rid = lax.dynamic_slice(rid_p, (sa,), (S,))
+                key = jnp.where(in_seg,
+                                jnp.where(go_left, 1, 2),
+                                jnp.where(pos < off, 0, 3)).astype(jnp.int32)
+                key = jnp.where(do, key, 0)
+                ops = ([key] + [bw[i] for i in range(fw)]
+                       + [ww[0], ww[1], ww[2], rid, lid])
+                sd = lax.sort(ops, num_keys=1, is_stable=True)
+                bw2 = jnp.stack(sd[1:1 + fw])
+                ww2 = jnp.stack(sd[1 + fw:4 + fw])
+                rid2, lid2 = sd[4 + fw], sd[5 + fw]
+                lc_w = jnp.sum(segl.astype(jnp.int32)).astype(jnp.int32)
+                in_right = (pos >= off + lc_w) & (pos < off + c)
+                lid2 = jnp.where(do & in_right, new_leaf, lid2)
+                bins_p = lax.dynamic_update_slice(bins_p, bw2,
+                                                  (jnp.int32(0), sa))
+                w_p = lax.dynamic_update_slice(w_p, ww2, (jnp.int32(0), sa))
+                rid_p = lax.dynamic_update_slice(rid_p, rid2, (sa,))
+                lid_p = lax.dynamic_update_slice(lid_p, lid2, (sa,))
+                ls, lw = s, lc_w
+                rs, rw = s + lc_w, c - lc_w
+            else:
+                lid2 = jnp.where(do & in_seg & ~go_left, new_leaf, lid)
+                lid_p = lax.dynamic_update_slice(lid_p, lid2, (sa,))
+                ls = rs = s
+                lw = rw = c
+            return (bins_p, w_p, rid_p, lid_p, ls, lw, rs, rw, lc_bag,
+                    c_bag)
 
         return branch
+
+    # -- EFB unbundling ------------------------------------------------------
+
+    def _unbundle_hist(self, hist_g, sum_g, sum_h, cnt):
+        """(G, Bg, 3) bundle histogram → (F, Bf, 3) per-feature view; the
+        default-bin entry of each bundled member is rebuilt from the leaf
+        totals (``Dataset::FixHistogram``)."""
+        flat = hist_g.reshape(-1, 3)
+        view = flat[self._ub_idx]
+        view = view * self._ub_valid[..., None].astype(view.dtype)
+        dt = view.dtype
+        totals = jnp.stack([sum_g.astype(dt), sum_h.astype(dt),
+                            cnt.astype(dt)])
+        dflt = totals[None, :] - jnp.sum(view, axis=1)
+        bsel = (jnp.arange(view.shape[1])[None, :]
+                == self.f_default_bin[:, None]) & self._ub_fix[:, None]
+        return jnp.where(bsel[..., None], dflt[:, None, :], view)
+
+    def _feature_cands(self, hist, sum_g, sum_h, cnt, feature_mask,
+                       min_c=None, max_c=None):
+        if self._bundle is not None:
+            hist = self._unbundle_hist(hist, sum_g, sum_h, cnt)
+        return super()._feature_cands(hist, sum_g, sum_h, cnt, feature_mask,
+                                      min_c, max_c)
 
     # -- per-leaf candidates (packed rows) -----------------------------------
 
@@ -278,14 +371,15 @@ class CompactTPUTreeLearner(TPUTreeLearner):
 
     # -- root ----------------------------------------------------------------
 
-    def _init_root_compact(self, grad, hess, bag, feature_mask) -> CompactState:
+    def _init_root_compact(self, bins_p, grad, hess, bag, feature_mask
+                           ) -> CompactState:
         n, f, b, L = self.n_pad, self.num_features, self.num_bins_padded, \
             self.num_leaves
         acc = self._acc
         w = jnp.stack([grad * bag, hess * bag, bag], axis=0)
-        bins_p = self.bins_packed()
-        root_hist = self._hist_branches[-1](bins_p, w, jnp.int32(0),
-                                            jnp.int32(n))
+        lid0 = jnp.zeros(n, jnp.int32)
+        root_hist = self._hist_branches[-1](bins_p, w, lid0, jnp.int32(0),
+                                            jnp.int32(n), jnp.int32(0))
         sum_g = jnp.sum((grad * bag).astype(acc))
         sum_h = jnp.sum((hess * bag).astype(acc))
         cnt = jnp.sum(bag.astype(acc))
@@ -304,14 +398,14 @@ class CompactTPUTreeLearner(TPUTreeLearner):
             bins_p=bins_p,
             w_p=w,
             rid_p=jnp.arange(n, dtype=jnp.int32),
-            lid_p=jnp.zeros(n, jnp.int32),
+            lid_p=lid0,
             leaf_i=jnp.zeros((L, 2), jnp.int32).at[0, 1].set(n),
             leaf_f=jnp.zeros((L, NUM_LF), acc)
                       .at[:, LF_MIN_C].set(-jnp.inf)
                       .at[:, LF_MAX_C].set(jnp.inf)
                       .at[0].set(root_lf),
-            hist_pool=jnp.zeros((L, f, b, 3), root_hist.dtype).at[0]
-                         .set(root_hist),
+            hist_pool=jnp.zeros((L,) + root_hist.shape, root_hist.dtype)
+                         .at[0].set(root_hist),
             cand_f=jnp.zeros((L, NUM_CF), acc)
                       .at[:, CF_GAIN].set(-jnp.inf)
                       .at[0].set(cf_root[0]),
@@ -349,23 +443,26 @@ class CompactTPUTreeLearner(TPUTreeLearner):
 
         # ---- partition the parent's window (DataPartition::Split)
         pidx = self._bucket_idx(c)
-        bins_p, w_p, rid_p, lid_p, lc_w, lc_bag, c_bag = lax.switch(
-            pidx, self._partition_branches, state.bins_p, state.w_p,
-            state.rid_p, state.lid_p, s, c, feat, thr, dleft, is_cat,
-            crow_b, new_leaf, do)
-        rc_w = c - lc_w
+        bins_p, w_p, rid_p, lid_p, ls, lw, rs, rw, lc_bag, c_bag = \
+            lax.switch(
+                pidx, self._partition_branches, state.bins_p, state.w_p,
+                state.rid_p, state.lid_p, s, c, best_leaf, feat, thr, dleft,
+                is_cat, crow_b, new_leaf, do)
         lc_bag, c_bag = self._sync_counts(lc_bag, c_bag)
 
         # ---- smaller-child histogram + sibling subtraction
         # (`serial_tree_learner.cpp:371-385`); the smaller child is chosen by
         # BAGGED counts like the reference (left_cnt <= right_cnt), while the
-        # slice itself is that child's window
+        # slice itself is that child's window (mask-mode children share the
+        # parent's frozen window and are selected by leaf id)
         left_smaller = lc_bag <= (c_bag - lc_bag)
-        small_start = jnp.where(left_smaller, s, s + lc_w)
-        small_cnt = jnp.where(left_smaller, lc_w, rc_w)
+        small_leaf = jnp.where(left_smaller, best_leaf, new_leaf)
+        small_start = jnp.where(left_smaller, ls, rs)
+        small_cnt = jnp.where(left_smaller, lw, rw)
         hidx = self._bucket_idx(jnp.maximum(small_cnt, 1))
         hist_small = self._reduce_hist(lax.switch(
-            hidx, self._hist_branches, bins_p, w_p, small_start, small_cnt))
+            hidx, self._hist_branches, bins_p, w_p, lid_p, small_start,
+            small_cnt, small_leaf))
         hist_parent = state.hist_pool[best_leaf]
         hist_large = hist_parent - hist_small
         hist_left = jnp.where(left_smaller, hist_small, hist_large)
@@ -405,8 +502,8 @@ class CompactTPUTreeLearner(TPUTreeLearner):
         leaf_f = upd2(state.leaf_f, lf_l, lf_r)
         leaf_i = upd2(
             state.leaf_i,
-            jnp.stack([s, lc_w]).astype(jnp.int32),
-            jnp.stack([s + lc_w, rc_w]).astype(jnp.int32))
+            jnp.stack([ls, lw]).astype(jnp.int32),
+            jnp.stack([rs, rw]).astype(jnp.int32))
 
         # ---- children's best splits (with monotone constraint propagation)
         md = int(cfg.max_depth)
@@ -443,12 +540,16 @@ class CompactTPUTreeLearner(TPUTreeLearner):
 
     # -- whole tree ----------------------------------------------------------
 
-    def _train_tree_compact(self, grad, hess, bag, feature_mask):
+    def _train_tree_compact(self, bins_p, grad, hess, bag, feature_mask):
+        # bins arrive as an ARGUMENT, not a closure constant — embedded
+        # constants ship with every (remote) compile request
         self._hist_branches = [self._make_hist_branch(S)
                                for S in self._win_sizes]
-        self._partition_branches = [self._make_partition_branch(S)
-                                    for S in self._win_sizes]
-        state = self._init_root_compact(grad, hess, bag, feature_mask)
+        self._partition_branches = [
+            self._make_partition_branch(S, sort_mode=S > self._sort_cutoff)
+            for S in self._win_sizes]
+        state = self._init_root_compact(bins_p, grad, hess, bag,
+                                        feature_mask)
 
         def body(i, st):
             return self._split_step_compact(st, feature_mask, i)
@@ -469,8 +570,8 @@ class CompactTPUTreeLearner(TPUTreeLearner):
         (rec_f, rec_i, rec_cat, leaf_id, leaf_output)."""
         if feature_mask is None:
             feature_mask = jnp.ones(self.num_features, dtype=bool)
-        self.bins_packed()  # materialize the cache outside the trace
-        return self._jit_tree_c(grad, hess, bag, feature_mask)
+        return self._jit_tree_c(self.bins_packed(), grad, hess, bag,
+                                feature_mask)
 
     def assemble_host(self, rec_f, rec_i, rec_cat=None) -> Tree:
         return self._assemble_compact(

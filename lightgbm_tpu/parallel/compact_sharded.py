@@ -274,12 +274,14 @@ class ShardedCompactLearner(CompactTPUTreeLearner):
         acc = self._acc
         self._hist_branches = [self._make_hist_branch_shard(S)
                                for S in self._win_sizes]
-        self._partition_branches = [self._make_partition_branch(S)
-                                    for S in self._win_sizes]
+        self._partition_branches = [
+            self._make_partition_branch(S, sort_mode=S > self._sort_cutoff)
+            for S in self._win_sizes]
 
         w = jnp.stack([grad * bag, hess * bag, bag], axis=0)
-        local_root = self._hist_branches[-1](bins_p, w, jnp.int32(0),
-                                             jnp.int32(n))
+        lid0 = jnp.zeros(n, jnp.int32)
+        local_root = self._hist_branches[-1](bins_p, w, lid0, jnp.int32(0),
+                                             jnp.int32(n), jnp.int32(0))
         root_hist = self._reduce_hist(local_root)   # (fs, B, 3) scattered
         sum_g = lax.psum(jnp.sum((grad * bag).astype(acc)), axis)
         sum_h = lax.psum(jnp.sum((hess * bag).astype(acc)), axis)
@@ -336,13 +338,14 @@ class ShardedCompactLearner(CompactTPUTreeLearner):
         from ..ops.hist_pallas import unpack_bin_words
         from ..ops.histogram import build_histogram_onehot
 
-        def branch(bins_p, w_p, start, cnt):
+        def branch(bins_p, w_p, lid_p, start, cnt, leaf):
             sa = jnp.clip(start, 0, n - S).astype(jnp.int32)
             off = (start - sa).astype(jnp.int32)
             bw = lax.dynamic_slice(bins_p, (jnp.int32(0), sa), (fw, S))
             ww = lax.dynamic_slice(w_p, (jnp.int32(0), sa), (3, S))
+            lid = lax.dynamic_slice(lid_p, (sa,), (S,))
             pos = jnp.arange(S, dtype=jnp.int32)
-            m = ((pos >= off) & (pos < off + cnt))
+            m = (pos >= off) & (pos < off + cnt) & (lid == leaf)
             wm = ww * m[None, :].astype(ww.dtype)
             bu = unpack_bin_words(bw, fw * 4)     # keep padded features
             return build_histogram_onehot(bu, wm, num_bins=b,

@@ -1,0 +1,213 @@
+"""Device batch predictor — all trees traversed on device in bin space.
+
+The analogue of ``Predictor`` (`src/application/predictor.hpp:25-230`), but
+instead of per-row double traversal under OpenMP, the input matrix is binned
+once with the model's own mappers (exact training-time semantics) and ALL
+trees traverse on device as one jitted ``lax.scan`` over packed node arrays
+— each scan step advances every row through one tree level-synchronously.
+
+Prediction early stop (`src/boosting/prediction_early_stop.cpp`) becomes a
+per-row ``active`` lane re-evaluated every ``pred_early_stop_freq``
+iterations: frozen rows stop accumulating, the reference's per-row early
+exit (margin = 2|p| for binary, top1−top2 for multiclass).
+
+Requires the training bin mappers — available on a trained booster or one
+bound to a dataset; boosters loaded from model text fall back to the host
+numpy path in ``GBDT.predict_raw``.  The jitted traversal is module-level
+and keyed on pack SHAPES, so rebuilding packs per call (leaf values change
+under DART reweighting) does not recompile.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from .tree import Tree
+
+
+def pack_trees(models: List[Tree], num_class: int):
+    """Stack per-tree node arrays padded to the fleet maxima; inner
+    (bin-space) fields, so every decision is an integer compare or a bitset
+    probe."""
+    T = len(models)
+    ni = max(max(t.num_leaves - 1, 1) for t in models)
+    nl = max(max(t.num_leaves, 1) for t in models)
+    depth = max(max(int(t.leaf_depth[:t.num_leaves].max()), 1)
+                for t in models)
+    feat = np.zeros((T, ni), np.int32)
+    thr = np.zeros((T, ni), np.int32)
+    dtyp = np.zeros((T, ni), np.int32)
+    lch = np.full((T, ni), -1, np.int32)
+    rch = np.full((T, ni), -1, np.int32)
+    # f64 leaf values/accumulation when x64 is enabled (CPU tests, dp
+    # runs); the production f32 TPU path accumulates in f32 — documented
+    # divergence from the host f64 sum at ~1e-7 relative per tree
+    import jax as _jax
+    lv_dtype = np.float64 if _jax.config.jax_enable_x64 else np.float32
+    lval = np.zeros((T, nl), lv_dtype)
+    cat_lo = np.zeros((T, ni), np.int32)
+    cat_hi = np.zeros((T, ni), np.int32)
+    cat_words: List[List[int]] = []
+    tree_class = np.arange(T, dtype=np.int32) % max(num_class, 1)
+    for i, t in enumerate(models):
+        k = t.num_leaves - 1
+        words: List[int] = []
+        if t.num_leaves <= 1:
+            lval[i, 0] = t.leaf_value[0]   # children -1 → leaf 0
+        else:
+            feat[i, :k] = t.split_feature_inner[:k]
+            thr[i, :k] = t.threshold_in_bin[:k]
+            dtyp[i, :k] = t.decision_type[:k]
+            lch[i, :k] = t.left_child[:k]
+            rch[i, :k] = t.right_child[:k]
+            lval[i, :t.num_leaves] = t.leaf_value[:t.num_leaves]
+            if t.num_cat > 0:
+                inner = getattr(t, "_cat_bitsets_inner", {})
+                for nd in range(k):
+                    if t.decision_type[nd] & 1:
+                        cat_idx = int(t.threshold_in_bin[nd])
+                        bins = sorted(inner.get(cat_idx, ()))
+                        w0 = len(words)
+                        nw = (bins[-1] // 32 + 1) if bins else 0
+                        chunk = [0] * nw
+                        for b_ in bins:
+                            chunk[b_ // 32] |= 1 << (b_ % 32)
+                        words.extend(chunk)
+                        cat_lo[i, nd] = w0
+                        cat_hi[i, nd] = w0 + nw
+        cat_words.append(words)
+    W = max((len(w) for w in cat_words), default=0) or 1
+    cat_bits = np.zeros((T, W), np.uint32)
+    for i, words in enumerate(cat_words):
+        cat_bits[i, :len(words)] = np.asarray(words, np.uint32)
+    packs = dict(
+        feat=jnp.asarray(feat), thr=jnp.asarray(thr),
+        dtyp=jnp.asarray(dtyp), lch=jnp.asarray(lch), rch=jnp.asarray(rch),
+        lval=jnp.asarray(lval), cat_bits=jnp.asarray(cat_bits),
+        cat_lo=jnp.asarray(cat_lo), cat_hi=jnp.asarray(cat_hi),
+        cls=jnp.asarray(tree_class))
+    return packs, depth
+
+
+def _one_tree(bins, p, f_missing, f_default_bin, f_nan_bin, depth):
+    """(N,) leaf values of one packed tree over the binned matrix."""
+    n = bins.shape[1]
+    node = jnp.zeros(n, jnp.int32)
+    rows = jnp.arange(n)
+
+    def step(node, _):
+        nd = jnp.maximum(node, 0)
+        f = p["feat"][nd]
+        fv = bins[f, rows].astype(jnp.int32)
+        dt = p["dtyp"][nd]
+        mt = f_missing[f]
+        is_missing = ((mt == 1) & (fv == f_default_bin[f])) | \
+                     ((mt == 2) & (fv == f_nan_bin[f]))
+        go_left = jnp.where(is_missing, (dt & 2) != 0, fv <= p["thr"][nd])
+        # categorical: inner bitset probe (CategoricalDecisionInner)
+        lo = p["cat_lo"][nd]
+        nw = p["cat_hi"][nd] - lo
+        widx = fv >> 5
+        word = p["cat_bits"][jnp.clip(lo + widx, 0,
+                                      p["cat_bits"].shape[0] - 1)]
+        in_set = (widx < nw) & \
+            (((word >> (fv & 31).astype(jnp.uint32)) & 1) == 1)
+        go_left = jnp.where((dt & 1) != 0, in_set, go_left)
+        nxt = jnp.where(go_left, p["lch"][nd], p["rch"][nd])
+        return jnp.where(node < 0, node, nxt), None
+
+    node, _ = lax.scan(step, node, None, length=depth)
+    leaf = jnp.where(node < 0, ~node, 0)
+    return p["lval"][leaf]
+
+
+@functools.partial(jax.jit, static_argnames=("depth", "K", "es", "es_freq",
+                                             "es_margin"))
+def _predict_all(bins, packs, f_missing, f_default_bin, f_nan_bin, *,
+                 depth: int, K: int, es: bool, es_freq: int,
+                 es_margin: float):
+    n = bins.shape[1]
+    T = packs["feat"].shape[0]
+    score0 = jnp.zeros((K, n), packs["lval"].dtype)
+    active0 = jnp.ones(n, jnp.bool_)
+
+    def tree_step(carry, xs):
+        score, active = carry
+        t_idx, pack = xs
+        vals = _one_tree(bins, pack, f_missing, f_default_bin, f_nan_bin,
+                         depth)
+        if es:
+            # re-evaluate frozen lanes at iteration boundaries
+            # (`predictor.hpp` early-stop hook cadence)
+            at_check = (t_idx % (es_freq * K) == 0) & (t_idx > 0)
+            if K == 1:
+                margin = 2.0 * jnp.abs(score[0])
+            else:
+                top2 = lax.top_k(score.T, 2)[0]
+                margin = top2[:, 0] - top2[:, 1]
+            still = margin <= es_margin
+            active = jnp.where(at_check, active & still, active)
+            vals = vals * active.astype(vals.dtype)
+        score = score.at[pack["cls"]].add(vals)
+        return (score, active), None
+
+    (score, _), _ = lax.scan(tree_step, (score0, active0),
+                             (jnp.arange(T), packs))
+    return score
+
+
+class DevicePredictor:
+    """Batched device inference over the model's own bin space."""
+
+    def __init__(self, gbdt, data, num_iteration: int = -1,
+                 pred_early_stop: bool = False,
+                 pred_early_stop_freq: int = 10,
+                 pred_early_stop_margin: float = 10.0):
+        self.data = data
+        n_models = gbdt._num_models_for(num_iteration)
+        models = gbdt.models[:n_models]
+        if not models:
+            raise ValueError("no trees to predict with")
+        self.K = max(gbdt.num_tree_per_iteration, 1)
+        num_bin, missing, default_bin, _ = data.feature_meta_arrays()
+        self.f_missing = jnp.asarray(missing)
+        self.f_default_bin = jnp.asarray(default_bin)
+        self.f_nan_bin = jnp.asarray(num_bin - 1)
+        self.packs, self.depth = pack_trees(models, self.K)
+        self.es = bool(
+            pred_early_stop and gbdt.objective is not None
+            and gbdt.objective.name in ("binary", "multiclass",
+                                        "multiclassova"))
+        self.es_freq = max(int(pred_early_stop_freq), 1)
+        self.es_margin = float(pred_early_stop_margin)
+
+    def predict_binned(self, bins: jax.Array) -> jax.Array:
+        """(K, N) raw scores from an (F_pad, N) device bin matrix."""
+        return _predict_all(
+            bins, self.packs, self.f_missing, self.f_default_bin,
+            self.f_nan_bin, depth=self.depth, K=self.K, es=self.es,
+            es_freq=self.es_freq, es_margin=self.es_margin)
+
+    # categories unseen at train time probe past every split bitset → right
+    # child, matching raw-value traversal (`tree.h:250-268`)
+    OOV_BIN = 1 << 20
+
+    def predict_raw(self, X: np.ndarray) -> np.ndarray:
+        """(n,) or (n, K) raw scores; X binned host-side with the model's
+        own mappers (raw-prediction semantics for categoricals)."""
+        n = X.shape[0]
+        fu = self.data.num_used_features
+        f_pad = self.data.bins.shape[0]
+        bins = np.zeros((f_pad, n), dtype=np.int32)
+        for k in range(fu):
+            j = int(self.data.used_feature_map[k])
+            bins[k] = self.data.bin_mappers[k].values_to_bins_predict(
+                X[:, j], self.OOV_BIN)
+        score = np.asarray(self.predict_binned(jnp.asarray(bins)))
+        return score[0] if self.K == 1 else score.T

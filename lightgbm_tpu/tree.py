@@ -188,14 +188,19 @@ class Tree:
             cat_left = np.zeros(len(fval), dtype=bool)
             for i in np.where(is_cat)[0]:
                 v = fval[i]
-                if np.isnan(v) or int(v) < 0:
-                    cat_left[i] = False
-                else:
-                    cat_idx = int(self.threshold[node[i]])
-                    cat_left[i] = _in_bitset(
-                        self.cat_threshold,
-                        self.cat_boundaries[cat_idx], self.cat_boundaries[cat_idx + 1],
-                        int(v))
+                # `tree.h:250-262`: negative → right; NaN → right only for
+                # missing_type NaN, else probed as category 0
+                if np.isnan(v):
+                    if missing_type[i] == 2:
+                        continue
+                    v = 0.0
+                if int(v) < 0:
+                    continue
+                cat_idx = int(self.threshold[node[i]])
+                cat_left[i] = _in_bitset(
+                    self.cat_threshold,
+                    self.cat_boundaries[cat_idx], self.cat_boundaries[cat_idx + 1],
+                    int(v))
             go_left = np.where(is_cat, cat_left, go_left)
         return go_left
 

@@ -77,7 +77,7 @@ def test_cli_convert_model(tmp_path, monkeypatch):
 int main(int argc, char** argv) {
   double arr[4];
   while (std::scanf("%lf,%lf,%lf,%lf", arr, arr+1, arr+2, arr+3) == 4) {
-    std::printf("%.10f\n", Predict(arr));
+    std::printf("%.17g\n", Predict(arr));
   }
   return 0;
 }

@@ -120,3 +120,22 @@ def test_factory_routing():
     cfg3 = Config.from_params({**params, "tree_learner": "data"})
     l3 = create_tree_learner(cfg3, d)
     assert not isinstance(l3, CompactTPUTreeLearner)
+
+
+def test_sort_and_mask_partition_modes_agree(rng):
+    """tpu_sort_cutoff splits the tree into physically-compacted (sorted)
+    windows above and frozen mask-mode windows below — both must produce
+    the same model as the masked learner."""
+    import lightgbm_tpu as lgb
+    X = rng.randn(8192, 10)
+    y = X[:, 0] * 2 - X[:, 1] + 0.2 * rng.randn(8192)
+    preds = {}
+    for cutoff in (0, 2048, 1 << 30):   # all-sort / hybrid / all-mask
+        params = {"objective": "regression", "num_leaves": 31,
+                  "min_data_in_leaf": 20, "verbosity": -1,
+                  "tpu_sort_cutoff": cutoff}
+        bst = lgb.train(params, lgb.Dataset(X, label=y), 8)
+        preds[cutoff] = bst.predict(X)
+    np.testing.assert_allclose(preds[0], preds[1 << 30], rtol=1e-5,
+                               atol=1e-6)
+    np.testing.assert_allclose(preds[0], preds[2048], rtol=1e-5, atol=1e-6)

@@ -1,0 +1,91 @@
+"""Exclusive Feature Bundling (EFB) — `src/io/dataset.cpp:67-213`."""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.efb import find_bundles
+
+
+def _sparse_exclusive(rng, n=6000, blocks=4, per_block=3):
+    """blocks × per_block one-hot-ish features: inside a block exactly one
+    feature is non-zero per row — perfectly exclusive."""
+    cols = []
+    y = np.zeros(n)
+    for b in range(blocks):
+        which = rng.randint(0, per_block, n)
+        vals = rng.randn(n) * (1 + b)
+        for j in range(per_block):
+            col = np.where(which == j, vals, 0.0)
+            cols.append(col)
+            y += np.where(which == j, (j + 1) * col, 0.0) * 0.3
+    X = np.column_stack(cols + [rng.randn(n)])   # plus one dense feature
+    y += 0.5 * X[:, -1] + 0.05 * rng.randn(n)
+    return X, y
+
+
+def test_bundles_found_and_axis_reduced(rng):
+    X, y = _sparse_exclusive(rng)
+    ds = lgb.Dataset(X, label=y, params={"verbosity": -1, "max_bin": 63})
+    ds.construct()
+    data = ds.constructed
+    # 64-bin features fit the 256-bin group cap (the reference GPU path cap)
+    assert data.bundle is not None
+    # 12 exclusive features + 1 dense → far fewer histogram columns
+    assert data.bundle.num_groups < data.num_used_features
+    assert data.bundle.max_group_bin <= 256
+    groups = data.bundle.groups
+    assert any(len(g) > 1 for g in groups)
+
+
+def test_efb_predictions_unchanged(rng):
+    """max_conflict_rate=0 bundling is lossless — the model must be
+    IDENTICAL with and without bundling."""
+    X, y = _sparse_exclusive(rng)
+    params = {"objective": "regression", "num_leaves": 31, "verbosity": -1,
+              "min_data_in_leaf": 20, "max_bin": 63}
+    with_efb = lgb.train(params, lgb.Dataset(X, label=y), 10)
+    assert with_efb.gbdt.learner._bundle is not None
+    without = lgb.train(dict(params, enable_bundle=False),
+                        lgb.Dataset(X, label=y), 10)
+    assert without.gbdt.learner._bundle is None
+    np.testing.assert_allclose(with_efb.predict(X), without.predict(X),
+                               rtol=1e-5, atol=1e-6)
+    # identical structure, not merely similar predictions
+    for ta, tb in zip(with_efb.gbdt.models, without.gbdt.models):
+        np.testing.assert_array_equal(
+            ta.split_feature[:ta.num_leaves - 1],
+            tb.split_feature[:tb.num_leaves - 1])
+        np.testing.assert_allclose(
+            ta.threshold[:ta.num_leaves - 1],
+            tb.threshold[:tb.num_leaves - 1], rtol=1e-12)
+
+
+def test_efb_respects_conflicts(rng):
+    """Features that do co-occur must NOT bundle at max_conflict_rate=0."""
+    n = 4000
+    a = rng.randn(n) * (rng.rand(n) < 0.5)
+    b = rng.randn(n) * (rng.rand(n) < 0.5)   # overlaps with a ~25% of rows
+    X = np.column_stack([a, b])
+    y = a + b
+    ds = lgb.Dataset(X, label=y, params={"verbosity": -1})
+    ds.construct()
+    bundle = ds.constructed.bundle
+    if bundle is not None:
+        assert all(len(g) == 1 for g in bundle.groups)
+
+
+def test_efb_valid_sets_and_missing(rng):
+    X, y = _sparse_exclusive(rng)
+    Xv, yv = _sparse_exclusive(rng, n=1500)
+    params = {"objective": "regression", "num_leaves": 31, "verbosity": -1,
+              "min_data_in_leaf": 20, "metric": "l2", "max_bin": 63}
+    ds = lgb.Dataset(X, label=y, params=params)
+    dv = lgb.Dataset(Xv, label=yv, reference=ds)
+    evals = {}
+    bst = lgb.train(params, ds, 10, valid_sets=[dv], valid_names=["v"],
+                    evals_result=evals, verbose_eval=False)
+    # device valid-set traversal (per-feature bins) agrees with host predict
+    want = float(np.mean((bst.predict(Xv) - yv) ** 2))
+    np.testing.assert_allclose(evals["v"]["l2"][-1], want, rtol=1e-5)

@@ -1,0 +1,77 @@
+"""Device batch predictor vs the host per-tree path, incl. categorical
+trees, multiclass, and prediction early stop."""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.predictor import DevicePredictor
+
+
+def _host_raw(gbdt, X, num_iteration=-1):
+    X = np.ascontiguousarray(X, dtype=np.float64)
+    k = gbdt.num_tree_per_iteration
+    out = np.zeros((X.shape[0], k))
+    for i in range(gbdt._num_models_for(num_iteration)):
+        out[:, i % k] += gbdt.models[i].predict(X)
+    return out[:, 0] if k == 1 else out
+
+
+def test_device_predictor_matches_host(rng):
+    X = rng.randn(3000, 6)
+    y = (X[:, 0] + X[:, 1] * X[:, 2] > 0).astype(float)
+    bst = lgb.train({"objective": "binary", "num_leaves": 31,
+                     "verbosity": -1, "min_data_in_leaf": 10},
+                    lgb.Dataset(X, label=y), 20)
+    Xt = rng.randn(500, 6)
+    Xt[::17, 2] = np.nan  # exercise missing handling
+    dp = DevicePredictor(bst.gbdt, bst.gbdt.train_data)
+    np.testing.assert_allclose(dp.predict_raw(Xt), _host_raw(bst.gbdt, Xt),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_device_predictor_categorical_and_multiclass(rng):
+    n = 3000
+    X = np.column_stack([rng.randint(0, 15, n).astype(float),
+                         rng.randn(n), rng.randn(n)])
+    y = ((X[:, 0] % 3).astype(int)).astype(float)
+    bst = lgb.train({"objective": "multiclass", "num_class": 3,
+                     "num_leaves": 15, "verbosity": -1,
+                     "min_data_in_leaf": 10},
+                    lgb.Dataset(X, label=y, categorical_feature=[0]), 8)
+    Xt = np.column_stack([rng.randint(0, 18, 400).astype(float),  # unseen cats
+                          rng.randn(400), rng.randn(400)])
+    dp = DevicePredictor(bst.gbdt, bst.gbdt.train_data)
+    np.testing.assert_allclose(dp.predict_raw(Xt), _host_raw(bst.gbdt, Xt),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_predict_routes_through_device_for_large_batches(rng):
+    X = rng.randn(4000, 5)
+    y = (X[:, 0] > 0).astype(float)
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "verbosity": -1, "min_data_in_leaf": 10},
+                    lgb.Dataset(X, label=y), 60)  # 4000*60 > 200k → device
+    p = bst.predict(X)
+    host = bst.gbdt.objective.convert_output(_host_raw(bst.gbdt, X))
+    np.testing.assert_allclose(p, host, rtol=1e-4, atol=1e-6)
+
+
+def test_pred_early_stop_freezes_confident_rows(rng):
+    X = rng.randn(3000, 4)
+    y = (X[:, 0] > 0).astype(float)
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "verbosity": -1, "min_data_in_leaf": 10,
+                     "learning_rate": 0.3}, lgb.Dataset(X, label=y), 40)
+    dp_off = DevicePredictor(bst.gbdt, bst.gbdt.train_data)
+    dp_on = DevicePredictor(bst.gbdt, bst.gbdt.train_data,
+                            pred_early_stop=True, pred_early_stop_freq=5,
+                            pred_early_stop_margin=1.0)
+    raw_off = dp_off.predict_raw(X)
+    raw_on = dp_on.predict_raw(X)
+    frozen = raw_on != raw_off
+    assert frozen.any(), "no rows froze despite a tight margin"
+    # frozen rows stopped past the margin — classification unchanged
+    assert ((raw_on > 0) == (raw_off > 0)).mean() > 0.99
+    # margin semantics: every frozen row was already confident
+    assert (2.0 * np.abs(raw_on[frozen]) > 1.0).all()
