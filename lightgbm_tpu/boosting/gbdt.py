@@ -684,8 +684,13 @@ class GBDT:
         # device batch predictor (`predictor.py`): exact bin-space traversal
         # of all trees in one scan — needs the training mappers; text-loaded
         # boosters without a bound dataset use the host path below
+        # the device predictor packs INNER (bin-space) tree fields — trees
+        # pending a rebind (refit/continue-training on a new dataset) must
+        # not take this path until rebound
         use_device = (self.train_data is not None and num_models > 0
-                      and (n * num_models >= 200_000 or cfg.pred_early_stop))
+                      and (n * num_models >= 200_000 or cfg.pred_early_stop)
+                      and not any(getattr(t, "needs_rebind", False)
+                                  for t in self.models[:num_models]))
         if use_device:
             from ..predictor import DevicePredictor
             key = (num_models, self._model_version, cfg.pred_early_stop,

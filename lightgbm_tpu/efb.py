@@ -40,14 +40,24 @@ def find_bundles(data, cfg) -> List[List[int]]:
     groups; singletons included."""
     n = data.num_data
     fu = data.num_used_features
-    max_conflict = int(n * float(cfg.max_conflict_rate))
+    # bound the exclusivity scan like the reference's sampled FindGroups —
+    # the exact full-N scan is O(F·G·N) and stalls construction on exactly
+    # the wide sparse data EFB targets
+    cap = max(int(cfg.bin_construct_sample_cnt), 1)
+    if n > cap:
+        sample = np.random.RandomState(cfg.data_random_seed).choice(
+            n, cap, replace=False)
+    else:
+        sample = slice(0, n)
+    n_eff = cap if n > cap else n
+    max_conflict = int(n_eff * float(cfg.max_conflict_rate))
     nondef = []
     counts = []
     for k, m in enumerate(data.bin_mappers):
         if m.bin_type == BIN_CATEGORICAL:
             nd = None          # categoricals stay un-bundled
         else:
-            nd = data.bins[k, :n] != m.default_bin
+            nd = data.bins[k, :n][sample] != m.default_bin
         nondef.append(nd)
         counts.append(int(nd.sum()) if nd is not None else -1)
     order = sorted(range(fu), key=lambda k: -counts[k])

@@ -372,6 +372,11 @@ def cv(params: Dict, train_set: Dataset, num_boost_round: int = 100,
     params = dict(params or {})
     if metrics is not None:
         params["metric"] = metrics
+    # params-carried round counts (num_iterations/n_estimators/...) win,
+    # like train()
+    if "num_iterations" not in params and num_boost_round is not None:
+        params["num_iterations"] = num_boost_round
+    num_boost_round = Config.from_params(params).num_iterations
     train_set.construct()
     full = train_set
     n = full.num_data()
@@ -402,38 +407,74 @@ def cv(params: Dict, train_set: Dataset, num_boost_round: int = 100,
                         reference=dtrain, params=params)
         if fpreproc is not None:
             dtrain, dtest, params = fpreproc(dtrain, dtest, dict(params))
-        bst = train(params, dtrain, num_boost_round, valid_sets=[dtest],
-                    valid_names=["valid"], fobj=fobj, feval=feval,
-                    verbose_eval=False, callbacks=list(callbacks or []))
+        params_fold = dict(params)
+        params_fold.pop("early_stopping_round", None)
+        bst = Booster(params=params_fold, train_set=dtrain)
+        bst.add_valid(dtest, "valid")
         cvbooster._append(bst)
-    # aggregate per-iteration metrics across folds
-    per_fold = [b.gbdt.eval_history.get("valid", {}) for b in cvbooster.boosters]
-    metric_names = set()
-    for h in per_fold:
-        metric_names.update(h.keys())
-    es_rounds = early_stopping_rounds or 0
-    best_iter = -1
-    for mname in sorted(metric_names):
-        rows = [h.get(mname, []) for h in per_fold]
-        iters = min(len(r) for r in rows)
-        means = [float(np.mean([r[i] for r in rows])) for i in range(iters)]
-        stds = [float(np.std([r[i] for r in rows])) for i in range(iters)]
-        results[f"{mname}-mean"] = means
-        results[f"{mname}-stdv"] = stds
-    if early_stopping_rounds:
-        # truncate at the best mean of the first metric
-        for mname in sorted(metric_names):
-            means = results[f"{mname}-mean"]
-            # assume lower is better unless known otherwise
-            from .metrics import _METRIC_TABLE
-            hb = getattr(_METRIC_TABLE.get(mname.split("@")[0], None),
-                         "is_higher_better", False)
-            arr = np.asarray(means)
-            best = int(np.argmax(arr) if hb else np.argmin(arr))
-            for key in list(results):
-                if key.startswith(mname):
-                    results[key] = results[key][:best + 1]
+
+    # lockstep boosting: one round across ALL folds, then aggregate and run
+    # the early-stopping logic (and user callbacks) on the AGGREGATED means
+    # — the reference's cv structure (`engine.py:334-447` +
+    # ``_agg_cv_result``), not a post-hoc truncation of independent folds
+    callbacks = list(callbacks or [])
+    cbs_before = sorted((cb for cb in callbacks
+                         if getattr(cb, "before_iteration", False)),
+                        key=lambda cb: getattr(cb, "order", 0))
+    cbs_after = sorted((cb for cb in callbacks
+                        if not getattr(cb, "before_iteration", False)),
+                       key=lambda cb: getattr(cb, "order", 0))
+    best_score: Dict[str, float] = {}
+    best_iter: Dict[str, int] = {}
+    stopped_at = -1
+    for it in range(num_boost_round):
+        env = callback_mod.CallbackEnv(
+            model=cvbooster, params=params, iteration=it,
+            begin_iteration=0, end_iteration=num_boost_round,
+            evaluation_result_list=None)
+        for cb in cbs_before:
+            cb(env)
+        finished = False
+        agg: Dict[str, List[float]] = collections.defaultdict(list)
+        hb_map: Dict[str, bool] = {}
+        for bst in cvbooster.boosters:
+            if bst.update(fobj=fobj):
+                finished = True
+            for dname, mname, val, hb in bst.eval_valid(feval):
+                agg[mname].append(val)
+                hb_map[mname] = hb
+        agg_list = []
+        for mname, vals in agg.items():
+            results[f"{mname}-mean"].append(float(np.mean(vals)))
+            results[f"{mname}-stdv"].append(float(np.std(vals)))
+            agg_list.append(("cv_agg", mname, float(np.mean(vals)),
+                             hb_map[mname], float(np.std(vals))))
+        try:
+            env = env._replace(evaluation_result_list=agg_list)
+            for cb in cbs_after:
+                cb(env)
+        except callback_mod.EarlyStopException as e:
+            stopped_at = getattr(e, "best_iteration", it)
             break
+        if early_stopping_rounds:
+            stop = False
+            for mname in agg:
+                factor = 1.0 if hb_map[mname] else -1.0
+                cur = factor * results[f"{mname}-mean"][-1]
+                if mname not in best_score or cur > best_score[mname]:
+                    best_score[mname] = cur
+                    best_iter[mname] = it
+                elif it - best_iter[mname] >= early_stopping_rounds:
+                    stop = True
+                    stopped_at = best_iter[mname]
+                    break
+            if stop:
+                break
+        if finished:
+            break
+    if stopped_at >= 0:
+        for key in list(results):
+            results[key] = results[key][:stopped_at + 1]
     return dict(results)
 
 

@@ -333,3 +333,23 @@ def test_objectives_smoke(rng):
                          "min_data_in_leaf": 5, "verbosity": -1}, ds, 5,
                         verbose_eval=False)
         assert np.isfinite(bst.predict(X)).all(), obj
+
+
+def test_cv_early_stopping_aggregated(rng):
+    """cv() runs folds in lockstep and stops on the AGGREGATED mean
+    (reference cv + _agg_cv_result semantics), truncating at the best
+    aggregated iteration."""
+    import lightgbm_tpu as lgb
+    X = rng.randn(600, 5)
+    y = X[:, 0] * 2 + rng.randn(600) * 2.0   # noisy: early stopping bites
+    res = lgb.cv({"objective": "regression", "num_leaves": 7,
+                  "verbosity": -1, "min_data_in_leaf": 10,
+                  "learning_rate": 0.3, "metric": "l2"},
+                 lgb.Dataset(X, label=y), num_boost_round=200,
+                 nfold=3, early_stopping_rounds=5, stratified=False,
+                 seed=7)
+    means = res["l2-mean"]
+    assert 0 < len(means) < 200, "early stopping never triggered"
+    # truncated AT the aggregated best (last entry is the minimum)
+    assert means[-1] == min(means)
+    assert len(res["l2-stdv"]) == len(means)

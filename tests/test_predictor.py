@@ -75,3 +75,17 @@ def test_pred_early_stop_freezes_confident_rows(rng):
     assert ((raw_on > 0) == (raw_off > 0)).mean() > 0.99
     # margin semantics: every frozen row was already confident
     assert (2.0 * np.abs(raw_on[frozen]) > 1.0).all()
+
+
+def test_refit_booster_large_batch_predict_matches_host(rng):
+    """Refit trees carry needs_rebind (inner fields are in the OLD bin
+    space) — the device predictor must not pack them (review regression)."""
+    X = rng.randn(4000, 4)
+    y = X[:, 0] * 2 + 0.1 * rng.randn(4000)
+    bst = lgb.train({"objective": "regression", "num_leaves": 15,
+                     "verbosity": -1, "min_data_in_leaf": 10},
+                    lgb.Dataset(X, label=y), 60)
+    refitted = bst.refit(X * 5 + 2, y, decay_rate=0.3)
+    p = refitted.predict(X * 5 + 2)          # 4000*60 > 200k threshold
+    want = _host_raw(refitted.gbdt, X * 5 + 2)
+    np.testing.assert_allclose(p, want, rtol=1e-6, atol=1e-8)
