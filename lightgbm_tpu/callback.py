@@ -49,15 +49,20 @@ def record_evaluation(eval_result: Dict) -> Callable:
         raise TypeError("eval_result should be a dictionary")
     eval_result.clear()
 
-    def _init(env: CallbackEnv) -> None:
-        for data_name, eval_name, _, _ in (env.evaluation_result_list or []):
-            eval_result.setdefault(data_name, collections.OrderedDict())
-            eval_result[data_name].setdefault(eval_name, [])
+    def _names(ret):
+        # train() passes 4-tuples; cv() passes 5-tuples ('cv_agg', name,
+        # mean, higher_better, stdv) which record as name-mean / name-stdv
+        if len(ret) == 5:
+            return [(ret[0], f"{ret[1]}-mean", ret[2]),
+                    (ret[0], f"{ret[1]}-stdv", ret[4])]
+        return [(ret[0], ret[1], ret[2])]
 
     def _callback(env: CallbackEnv) -> None:
-        _init(env)
-        for data_name, eval_name, result, _ in (env.evaluation_result_list or []):
-            eval_result[data_name][eval_name].append(result)
+        for ret in (env.evaluation_result_list or []):
+            for data_name, eval_name, result in _names(ret):
+                eval_result.setdefault(data_name, collections.OrderedDict())
+                eval_result[data_name].setdefault(eval_name, [])
+                eval_result[data_name][eval_name].append(result)
     _callback.order = 20
     return _callback
 

@@ -34,10 +34,11 @@ MAX_GROUP_BIN = 256
 
 
 def find_bundles(data, cfg) -> List[List[int]]:
-    """Greedy exclusive grouping over the full binned matrix (the reference
-    greedily scans sampled non-zero indices; the binned matrix is already
-    resident here, so exclusivity is exact).  Returns used-feature index
-    groups; singletons included."""
+    """Greedy exclusive grouping over the binned matrix.  Rows beyond
+    ``bin_construct_sample_cnt`` are SAMPLED (like the reference's
+    FindGroups over sampled indices), so on very large data exclusivity is
+    estimated and residual conflicts degrade within ``max_conflict_rate``
+    semantics.  Returns used-feature index groups; singletons included."""
     n = data.num_data
     fu = data.num_used_features
     # bound the exclusivity scan like the reference's sampled FindGroups —
@@ -45,8 +46,8 @@ def find_bundles(data, cfg) -> List[List[int]]:
     # the wide sparse data EFB targets
     cap = max(int(cfg.bin_construct_sample_cnt), 1)
     if n > cap:
-        sample = np.random.RandomState(cfg.data_random_seed).choice(
-            n, cap, replace=False)
+        sample = np.random.default_rng(cfg.data_random_seed).choice(
+            n, cap, replace=False)  # Generator.choice is O(cap), not O(n)
     else:
         sample = slice(0, n)
     n_eff = cap if n > cap else n

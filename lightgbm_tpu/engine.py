@@ -418,14 +418,21 @@ def cv(params: Dict, train_set: Dataset, num_boost_round: int = 100,
     # — the reference's cv structure (`engine.py:334-447` +
     # ``_agg_cv_result``), not a post-hoc truncation of independent folds
     callbacks = list(callbacks or [])
+    if early_stopping_rounds:
+        callbacks.append(callback_mod.early_stopping(
+            early_stopping_rounds, verbose=bool(verbose_eval)))
+    if isinstance(verbose_eval, int) and not isinstance(verbose_eval, bool) \
+            and verbose_eval > 0:
+        callbacks.append(callback_mod.print_evaluation(verbose_eval,
+                                                       show_stdv))
+    elif verbose_eval is True:
+        callbacks.append(callback_mod.print_evaluation(show_stdv=show_stdv))
     cbs_before = sorted((cb for cb in callbacks
                          if getattr(cb, "before_iteration", False)),
                         key=lambda cb: getattr(cb, "order", 0))
     cbs_after = sorted((cb for cb in callbacks
                         if not getattr(cb, "before_iteration", False)),
                        key=lambda cb: getattr(cb, "order", 0))
-    best_score: Dict[str, float] = {}
-    best_iter: Dict[str, int] = {}
     stopped_at = -1
     for it in range(num_boost_round):
         env = callback_mod.CallbackEnv(
@@ -456,20 +463,6 @@ def cv(params: Dict, train_set: Dataset, num_boost_round: int = 100,
         except callback_mod.EarlyStopException as e:
             stopped_at = getattr(e, "best_iteration", it)
             break
-        if early_stopping_rounds:
-            stop = False
-            for mname in agg:
-                factor = 1.0 if hb_map[mname] else -1.0
-                cur = factor * results[f"{mname}-mean"][-1]
-                if mname not in best_score or cur > best_score[mname]:
-                    best_score[mname] = cur
-                    best_iter[mname] = it
-                elif it - best_iter[mname] >= early_stopping_rounds:
-                    stop = True
-                    stopped_at = best_iter[mname]
-                    break
-            if stop:
-                break
         if finished:
             break
     if stopped_at >= 0:

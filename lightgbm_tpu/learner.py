@@ -58,6 +58,7 @@ class TreeState(NamedTuple):
     num_leaves: jax.Array    # () int32
     records: jax.Array       # (L-1, NUM_REC_FIELDS) f32
     rec_cat: jax.Array       # (L-1, W) uint32 — bin bitset of cat splits
+    rec_i: jax.Array         # (L-1, 2) int32 — exact bagged left/right counts
     leaf_min_c: jax.Array    # (L,) monotone value constraints per leaf
     leaf_max_c: jax.Array
 
@@ -311,6 +312,7 @@ class TPUTreeLearner:
             num_leaves=jnp.asarray(1, jnp.int32),
             records=jnp.zeros((L - 1, NUM_REC_FIELDS), jnp.float32),
             rec_cat=jnp.zeros((L - 1, self.cat_W), jnp.uint32),
+            rec_i=jnp.zeros((L - 1, 2), jnp.int32),
             leaf_min_c=jnp.full(L, -jnp.inf, jnp.float32),
             leaf_max_c=jnp.full(L, jnp.inf, jnp.float32))
 
@@ -343,6 +345,12 @@ class TPUTreeLearner:
             go_left = jnp.where(info.is_cat, cat_left.astype(bool), go_left)
         at_leaf = state.leaf_id == best_leaf
         leaf_id = jnp.where(do & at_leaf & ~go_left, new_leaf, state.leaf_id)
+        # exact integer bagged counts — the f32 histogram count channel
+        # loses integer exactness past 2^24 rows (round-1 advisor hazard)
+        bag_b = bag > 0.5
+        lc_bag = jnp.sum((at_leaf & go_left & bag_b).astype(jnp.int32)) \
+                    .astype(jnp.int32)
+        c_bag = jnp.sum((at_leaf & bag_b).astype(jnp.int32)).astype(jnp.int32)
 
         # ---- smaller-child histogram + sibling subtraction
         # (`serial_tree_learner.cpp:371-385`)
@@ -424,13 +432,15 @@ class TPUTreeLearner:
         rec = rec.at[REC_IS_CAT].set(info.is_cat.astype(jnp.float32))
         records = state.records.at[step_idx].set(rec)
         rec_cat = state.rec_cat.at[step_idx].set(info.cat_bits)
+        rec_i = state.rec_i.at[step_idx].set(
+            jnp.stack([lc_bag, c_bag - lc_bag]).astype(jnp.int32))
 
         return TreeState(
             leaf_id=leaf_id, hist_pool=hist_pool, leaf_sum_g=leaf_sum_g,
             leaf_sum_h=leaf_sum_h, leaf_cnt=leaf_cnt, leaf_output=leaf_output,
             leaf_depth=leaf_depth, cand=new_cand,
             num_leaves=state.num_leaves + do.astype(jnp.int32),
-            records=records, rec_cat=rec_cat,
+            records=records, rec_cat=rec_cat, rec_i=rec_i,
             leaf_min_c=leaf_min_c, leaf_max_c=leaf_max_c)
 
     def _train_tree_fused(self, grad, hess, bag, feature_mask) -> TreeState:
@@ -450,17 +460,17 @@ class TPUTreeLearner:
     def train_async(self, grad: jax.Array, hess: jax.Array, bag: jax.Array,
                     feature_mask: Optional[jax.Array] = None):
         """Dispatch one tree build; returns device arrays with NO host sync:
-        (rec_f, rec_i, rec_cat, leaf_id, leaf_output).  rec_i is None for
-        the masked learner (counts live in the f32 record)."""
+        (rec_f, rec_i, rec_cat, leaf_id, leaf_output)."""
         if feature_mask is None:
             feature_mask = jnp.ones(self.num_features, dtype=bool)
         state = self._jit_tree(grad, hess, bag, feature_mask)
-        return (state.records, None, state.rec_cat, state.leaf_id,
+        return (state.records, state.rec_i, state.rec_cat, state.leaf_id,
                 state.leaf_output)
 
     def assemble_host(self, rec_f, rec_i, rec_cat=None) -> Tree:
         return self._assemble(np.asarray(rec_f),
-                              None if rec_cat is None else np.asarray(rec_cat))
+                              None if rec_cat is None else np.asarray(rec_cat),
+                              None if rec_i is None else np.asarray(rec_i))
 
     def train(self, grad: jax.Array, hess: jax.Array, bag: jax.Array,
               feature_mask: Optional[jax.Array] = None, fused: bool = True
@@ -478,7 +488,8 @@ class TPUTreeLearner:
                 state = self._jit_step(state, grad, hess, bag, feature_mask,
                                        jnp.asarray(i, jnp.int32))
         records = np.asarray(state.records)  # single host sync per tree
-        tree = self._assemble(records, np.asarray(state.rec_cat))
+        tree = self._assemble(records, np.asarray(state.rec_cat),
+                              np.asarray(state.rec_i))
         return tree, state.leaf_id
 
     def _split_host_tree(self, tree: Tree, r: np.ndarray,
@@ -517,14 +528,19 @@ class TPUTreeLearner:
         tree.internal_value[tree.num_leaves - 2] = float(r[REC_INTERNAL_VALUE])
 
     def _assemble(self, records: np.ndarray,
-                  rec_cat: Optional[np.ndarray] = None) -> Tree:
+                  rec_cat: Optional[np.ndarray] = None,
+                  rec_i: Optional[np.ndarray] = None) -> Tree:
         tree = Tree(self.num_leaves)
         for i in range(records.shape[0]):
             r = records[i]
             if r[REC_VALID] < 0.5:
                 break
+            if rec_i is not None:
+                lc, rc = int(rec_i[i, 0]), int(rec_i[i, 1])
+            else:
+                lc = int(round(float(r[REC_LEFT_CNT])))
+                rc = int(round(float(r[REC_RIGHT_CNT])))
             self._split_host_tree(
                 tree, r, None if rec_cat is None else rec_cat[i],
-                left_cnt=int(round(float(r[REC_LEFT_CNT]))),
-                right_cnt=int(round(float(r[REC_RIGHT_CNT]))))
+                left_cnt=lc, right_cnt=rc)
         return tree
