@@ -30,6 +30,7 @@ from ..dataset import Dataset, _ConstructedDataset
 from ..learner import TPUTreeLearner
 from ..metrics import Metric, create_metric
 from ..objectives import ObjectiveFunction, create_objective
+from ..ops.lookup import lookup_f32 as _lookup_small
 from ..tree import Tree
 
 K_MODEL_VERSION = "v2"
@@ -63,9 +64,13 @@ class ScoreUpdater:
     def add_by_leaf_id(self, leaf_values: np.ndarray, leaf_id: jax.Array,
                        class_id: int) -> None:
         """Train-side update: gather the (host-renewed, shrunk) leaf values by
-        the learner's final leaf partition (`score_updater.hpp:74-96`)."""
+        the learner's final leaf partition (`score_updater.hpp:74-96`).
+
+        The per-row lookup is a one-hot matmul, not an XLA gather — on TPU a
+        1M-row gather from a small table costs ~8 ms while the MXU one-hot
+        contraction is ~0.5 ms (profiling/profile_gather_alts.py)."""
         lv = jnp.asarray(leaf_values.astype(np.float32))
-        self.score = self.score.at[class_id].add(lv[leaf_id])
+        self.score = self.score.at[class_id].add(_lookup_small(lv, leaf_id))
 
     def add_by_tree(self, tree: Tree, class_id: int) -> None:
         """Valid-side update: traverse the tree over this dataset's binned

@@ -325,6 +325,14 @@ class Config:
     # windows at or below this size stop physically compacting (mask-mode
     # partitions): small bitonic sorts are pure stage latency on TPU
     tpu_sort_cutoff: int = 2048
+    # frontier-wave learner: split up to this many leaves per batched wave
+    # (partition/histogram/scan amortized across the wave; an exact greedy
+    # replay trims the speculative forest back to best-first semantics)
+    tpu_wave_width: int = 64
+    # byte budget for the wave learner's histogram pool + per-wave child
+    # histograms; configs that exceed it fall back to the sequential
+    # compact learner
+    tpu_wave_max_bytes: int = 1 << 31
 
     # derived (not user-settable)
     is_parallel: bool = field(default=False, repr=False)

@@ -469,7 +469,7 @@ class _ConstructedDataset:
         # (reference-linked) skip the exclusivity scan entirely
         if not is_reference_linked \
                 and cfg.enable_bundle and cfg.tree_learner == "serial" \
-                and cfg.tpu_learner in ("auto", "compact") \
+                and cfg.tpu_learner in ("auto", "wave", "compact") \
                 and self.max_num_bin <= 256 and fu > 1:
             from .efb import find_bundles, apply_bundles
             groups = find_bundles(self, cfg)

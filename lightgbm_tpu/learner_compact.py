@@ -84,14 +84,18 @@ class CompactTPUTreeLearner(TPUTreeLearner):
     docstring).  Factory slot: `src/treelearner/tree_learner.cpp:9-33`,
     (tree_learner=serial, device_type=tpu)."""
 
+    _supports_bundle = True
+
     def __init__(self, cfg: Config, data: _ConstructedDataset,
                  hist_backend: str = "auto"):
         super().__init__(cfg, data, hist_backend)
         self.n_pad = int(data.num_data_padded)
         # EFB: histograms and the device row payload live in BUNDLE columns
         # (`efb.py`); the per-feature view is reconstructed at scan time
+        # (the sharded subclass opts out — its feature-axis scatter assumes
+        # unbundled columns)
         self._bundle = getattr(data, "bundle", None) \
-            if type(self) is CompactTPUTreeLearner else None
+            if self._supports_bundle else None
         if self._bundle is not None:
             bu = self._bundle
             from .dataset import _round_up
@@ -604,13 +608,19 @@ def create_tree_learner(cfg: Config, data: _ConstructedDataset,
     """(tree_learner, device) → learner, the analogue of
     ``TreeLearner::CreateTreeLearner`` (`src/treelearner/tree_learner.cpp:9-33`).
 
-    The compact learner is the default; the masked learner remains for
-    >256-bin datasets (bin codes don't pack 4-per-word) and for the GSPMD
-    parallel modes (whose sharding drapes over the masked learner's full-row
-    passes until the shard_map path lands).
+    The frontier-wave learner (`learner_wave.py`) is the default where
+    eligible; the sequential compact learner covers the rest of serial mode;
+    the masked learner remains for >256-bin datasets (bin codes don't pack
+    4-per-word) and for the GSPMD parallel modes (whose sharding drapes over
+    the masked learner's full-row passes).
     """
     mode = cfg.tpu_learner
     if mode == "auto":
+        mode = "wave"
+    if mode == "wave":
+        from .learner_wave import WaveTPUTreeLearner, wave_eligible
+        if wave_eligible(cfg, data):
+            return WaveTPUTreeLearner(cfg, data, hist_backend)
         mode = "compact"
     if mode == "compact":
         if data.max_num_bin > 256 or cfg.tree_learner not in ("serial",):

@@ -1,0 +1,699 @@
+"""Frontier-wave TPU tree learner: batched speculative leaf-wise growth.
+
+The sequential compact learner (`learner_compact.py`) builds a tree as 254
+dependent split steps inside one XLA program; at 1M rows the program floors
+at ~90 ms/tree of per-step bookkeeping and per-window sort latency before
+any real data work (profiling/PROFILE.md).  This learner restructures the
+growth into ~13 *frontier waves* while preserving exact best-first
+(leaf-wise) semantics:
+
+  1. **Grow.**  Each wave splits the top-W positive-gain frontier leaves at
+     once: one full-array stable sort re-compacts every split window
+     simultaneously (per-row split parameters come from an MXU mask-matmul,
+     never an XLA gather — `profiling/profile_gather_alts.py`), then the
+     smaller-child histograms run per member (subtraction for siblings) and
+     all 2W children are scanned in one batched split finder.  Replayed
+     against real split sequences, top-W selection reproduces the true
+     greedy split set with ~zero waste in ~12.6 waves
+     (`scratch/wave_sim.py`).
+  2. **Trim.**  An exact greedy replay over the grown forest re-derives the
+     reference's pop order (`serial_tree_learner.cpp:185-218`: split the
+     globally best leaf, insert its children): children's gains are all
+     known, so the replay is pure bookkeeping — ~6 ms of tiny ops.  The
+     replayed pop sequence assigns the reference leaf numbering (left child
+     inherits the parent index, right child gets ``num_leaves``), emits the
+     host-assembly records in pop order, and maps speculative leaves back
+     to their final ancestors.
+  3. **Correct.**  If the replay wants to pop a leaf the growth never split
+     (possible near the num_leaves budget where speculation and greedy can
+     diverge), it splits that leaf on the spot — a mask-mode single split —
+     and continues.  Slot arrays are sized so this path can never overflow
+     (growth ≤ budget splits, stalls ≤ budget pops), so the result is
+     always *exactly* the best-first tree.
+
+Everything the sequential learners guarantee is preserved: identical gain
+math and tie-breaks (lowest leaf index, `serial_tree_learner.cpp:505-520`),
+smaller-child histogram + sibling subtraction (`:371-385`), monotone
+constraint propagation, categorical bitset splits, EFB bundle decoding,
+exact integer bagged counts, and the host record format — so
+``assemble_host`` and the whole boosting loop are unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from .binning import MISSING_NAN, MISSING_ZERO
+from .config import Config
+from .dataset import _ConstructedDataset
+from .learner import NUM_REC_FIELDS
+from .learner_compact import (CF_GAIN, CF_LCNT, CF_LOUT, CF_LSG, CF_LSH,
+                              CF_RCNT, CF_ROUT, CF_RSG, CF_RSH, CI_FEAT,
+                              CI_FLAGS, CI_THR, LF_CNT, LF_DEPTH, LF_MAX_C,
+                              LF_MIN_C, LF_OUT, LF_SUM_G, LF_SUM_H, NUM_CF,
+                              NUM_CI, NUM_LF, CompactTPUTreeLearner)
+from .ops.lookup import lookup_int
+
+_HIGH = lax.Precision.HIGHEST
+
+
+class WaveState(NamedTuple):
+    # row payloads, permuted so every leaf's rows are contiguous
+    bins_p: jax.Array     # (fw, N) int32 packed bin words
+    w_p: jax.Array        # (3, N) f32 (g*bag, h*bag, bag)
+    rid_p: jax.Array      # (N,) int32 original row ids
+    lid_p: jax.Array      # (N,) int32 node-slot ids
+    key_p: jax.Array      # (N,) int32 window-order sort keys (2*start+bit)
+    # per-node-slot state (M slots; a split allocates 2 fresh child slots)
+    node_i: jax.Array     # (M, 2) int32 window [start, width]
+    node_f: jax.Array     # (M, NUM_LF) acc sums/cnt/out/depth/bounds
+    cand_f: jax.Array     # (M, NUM_CF) acc best-split floats
+    cand_i: jax.Array     # (M, NUM_CI) int32 feature/threshold/flags
+    cand_b: jax.Array     # (M, Wc) uint32 categorical bitsets
+    parent: jax.Array     # (M,) int32
+    child0: jax.Array     # (M,) int32 left child slot (right = +1)
+    hslot: jax.Array      # (M,) int32 histogram pool slot
+    split_m: jax.Array    # (M,) bool node has been split
+    cnt_i: jax.Array      # (M, 2) int32 exact bagged child counts at split
+    hist_pool: jax.Array  # (H, F, B, 3)
+    num_nodes: jax.Array  # () int32
+    num_splits: jax.Array  # () int32
+
+
+class WaveTPUTreeLearner(CompactTPUTreeLearner):
+    """Frontier-wave serial learner (factory slot
+    `src/treelearner/tree_learner.cpp:9-33`, tree_learner=serial,
+    device_type=tpu; supersedes the sequential compact learner where
+    eligible)."""
+
+    def __init__(self, cfg: Config, data: _ConstructedDataset,
+                 hist_backend: str = "auto"):
+        super().__init__(cfg, data, hist_backend)
+        self.budget = self.num_leaves - 1
+        self.W = max(1, min(int(cfg.tpu_wave_width), self.budget))
+        # growth performs <= budget splits, the exact-replay correction
+        # <= budget more: slot/pool sizing makes overflow impossible
+        self.M = 1 + 4 * self.budget
+        self.H = 2 * self.budget + 2
+        F = self.num_features
+        if self._bundle is not None:
+            col = np.asarray(self._bundle.f_gcol, np.int32)
+            goff = np.asarray(self._bundle.f_off, np.int32)
+            bnd = np.asarray(self._bundle.f_bundled, np.int32)
+        else:
+            col = np.arange(F, dtype=np.int32)
+            goff = np.zeros(F, np.int32)
+            bnd = np.zeros(F, np.int32)
+        self.fw_col = jnp.asarray(col)
+        self.fw_goff = jnp.asarray(goff)
+        self.fw_bnd = jnp.asarray(bnd)
+        self._jit_tree_w = jax.jit(self._train_tree_wave)
+
+    # -- batched split finder -------------------------------------------------
+
+    def _cand_rows_batch(self, hists, sg, sh, cn, feature_mask, depth_ok,
+                         constraints):
+        """Best-split rows for K children in one vmapped scan
+        (generalizes ``_cand_rows_pair``)."""
+        if constraints is not None:
+            mins, maxs = constraints
+            cands = jax.vmap(
+                lambda h, g, hh, c, mn, mx: self._feature_cands(
+                    h, g, hh, c, feature_mask, mn, mx)
+            )(hists, sg, sh, cn, mins, maxs)
+        else:
+            cands = jax.vmap(
+                lambda h, g, hh, c: self._feature_cands(h, g, hh, c,
+                                                        feature_mask)
+            )(hists, sg, sh, cn)
+        return self._pack_cand_rows(cands, depth_ok)
+
+    # -- root -----------------------------------------------------------------
+
+    def _init_root_wave(self, bins_p, grad, hess, bag, feature_mask
+                        ) -> WaveState:
+        n, L, M, H = self.n_pad, self.num_leaves, self.M, self.H
+        acc = self._acc
+        w = jnp.stack([grad * bag, hess * bag, bag], axis=0)
+        lid0 = jnp.zeros(n, jnp.int32)
+        root_hist = self._hist_branches[-1](bins_p, w, lid0, jnp.int32(0),
+                                            jnp.int32(n), jnp.int32(0))
+        sum_g = jnp.sum((grad * bag).astype(acc))
+        sum_h = jnp.sum((hess * bag).astype(acc))
+        cnt = jnp.sum(bag.astype(acc))
+        md = int(self.cfg.max_depth)
+        depth_ok = jnp.asarray([True if md <= 0 else md > 0])
+        cf, ci, cb = self._cand_rows_batch(
+            root_hist[None], sum_g[None], sum_h[None], cnt[None],
+            feature_mask, depth_ok, None)
+        root_lf = jnp.asarray([0.0, 0.0, 0.0, 0.0, 0.0, -jnp.inf, jnp.inf],
+                              acc)
+        root_lf = root_lf.at[LF_SUM_G].set(sum_g).at[LF_SUM_H].set(sum_h) \
+                         .at[LF_CNT].set(cnt)
+        return WaveState(
+            bins_p=bins_p, w_p=w,
+            rid_p=jnp.arange(n, dtype=jnp.int32),
+            lid_p=lid0,
+            key_p=jnp.zeros(n, jnp.int32),
+            node_i=jnp.zeros((M, 2), jnp.int32).at[0, 1].set(n),
+            node_f=jnp.zeros((M, NUM_LF), acc)
+                      .at[:, LF_MIN_C].set(-jnp.inf)
+                      .at[:, LF_MAX_C].set(jnp.inf)
+                      .at[0].set(root_lf),
+            cand_f=jnp.zeros((M, NUM_CF), acc)
+                      .at[:, CF_GAIN].set(-jnp.inf)
+                      .at[0].set(cf[0]),
+            cand_i=jnp.zeros((M, NUM_CI), jnp.int32).at[0].set(ci[0]),
+            cand_b=jnp.zeros((M, self.cat_W), jnp.uint32).at[0].set(cb[0]),
+            parent=jnp.zeros(M, jnp.int32),
+            child0=jnp.zeros(M, jnp.int32),
+            hslot=jnp.zeros(M, jnp.int32),
+            split_m=jnp.zeros(M, bool),
+            cnt_i=jnp.zeros((M, 2), jnp.int32),
+            hist_pool=jnp.zeros((H,) + root_hist.shape, root_hist.dtype)
+                         .at[0].set(root_hist),
+            num_nodes=jnp.asarray(1, jnp.int32),
+            num_splits=jnp.asarray(0, jnp.int32))
+
+    # -- one growth wave ------------------------------------------------------
+
+    def _pool_gains(self, st: WaveState):
+        alive = (jnp.arange(self.M) < st.num_nodes) & ~st.split_m
+        return jnp.where(alive, st.cand_f[:, CF_GAIN], -jnp.inf)
+
+    def _children_bookkeeping(self, st, wi, valid, lslot, rslot, lc_bag,
+                              c_bag, li, ri, lh, rh, hists2, feature_mask):
+        """Shared by the wave body (K=W) and the stall split (K=1): writes
+        all per-child node state given the children's histograms."""
+        acc = self._acc
+        K = wi.shape[0]
+        pcf = st.cand_f[wi]                       # (K, NUM_CF)
+        pci = st.cand_i[wi]
+        pnf = st.node_f[wi]
+        cd = pnf[:, LF_DEPTH] + 1.0
+        md = int(self.cfg.max_depth)
+        if md <= 0:
+            depth_ok = jnp.ones(2 * K, bool)
+        else:
+            depth_ok = jnp.repeat(cd < md, 2)
+        # monotone constraint propagation (`serial_tree_learner.cpp:765-776`)
+        pmin = pnf[:, LF_MIN_C]
+        pmax = pnf[:, LF_MAX_C]
+        if self.has_monotone:
+            feat = pci[:, CI_FEAT]
+            is_cat = (pci[:, CI_FLAGS] & 2) == 2
+            mono_t = jnp.where(is_cat, 0, self.f_monotone[feat])
+            mid = ((pcf[:, CF_LOUT] + pcf[:, CF_ROUT]) / 2.0).astype(acc)
+            lmin = jnp.where(mono_t < 0, mid, pmin)
+            lmax = jnp.where(mono_t > 0, mid, pmax)
+            rmin = jnp.where(mono_t > 0, mid, pmin)
+            rmax = jnp.where(mono_t < 0, mid, pmax)
+            mins2 = jnp.stack([lmin, rmin], 1).reshape(-1)
+            maxs2 = jnp.stack([lmax, rmax], 1).reshape(-1)
+            constraints = (mins2, maxs2)
+        else:
+            lmin = rmin = pmin
+            lmax = rmax = pmax
+            constraints = None
+        # batched child split scans
+        i2 = lambda a, b: jnp.stack([a, b], 1).reshape(-1)  # interleave K->2K
+        sg2 = i2(pcf[:, CF_LSG], pcf[:, CF_RSG])
+        sh2 = i2(pcf[:, CF_LSH], pcf[:, CF_RSH])
+        cn2 = i2(pcf[:, CF_LCNT], pcf[:, CF_RCNT])
+        cf2, ci2, cb2 = self._cand_rows_batch(
+            hists2, sg2, sh2, cn2, feature_mask, depth_ok, constraints)
+        # per-child leaf rows
+        lf_l = jnp.stack([pcf[:, CF_LSG], pcf[:, CF_LSH], pcf[:, CF_LCNT],
+                          pcf[:, CF_LOUT], cd, lmin, lmax], 1)
+        lf_r = jnp.stack([pcf[:, CF_RSG], pcf[:, CF_RSH], pcf[:, CF_RCNT],
+                          pcf[:, CF_ROUT], cd, rmin, rmax], 1)
+        lf2 = jnp.stack([lf_l, lf_r], 1).reshape(2 * K, NUM_LF).astype(acc)
+        # scatter everything (invalid members write out of bounds -> dropped)
+        oob = jnp.int32(self.M + 7)
+        ls_w = jnp.where(valid, lslot, oob)
+        rs_w = jnp.where(valid, rslot, oob)
+        s2 = i2(ls_w, rs_w)
+        st = st._replace(
+            node_i=st.node_i.at[ls_w].set(li).at[rs_w].set(ri),
+            node_f=st.node_f.at[s2].set(lf2),
+            cand_f=st.cand_f.at[s2].set(cf2),
+            cand_i=st.cand_i.at[s2].set(ci2),
+            cand_b=st.cand_b.at[s2].set(cb2),
+            parent=st.parent.at[s2].set(jnp.repeat(wi, 2)),
+            child0=st.child0.at[jnp.where(valid, wi, oob)].set(lslot),
+            hslot=st.hslot.at[ls_w].set(lh).at[rs_w].set(rh),
+            split_m=st.split_m.at[jnp.where(valid, wi, oob)].set(True),
+            cnt_i=st.cnt_i.at[jnp.where(valid, wi, oob)].set(
+                jnp.stack([lc_bag, c_bag - lc_bag], 1).astype(jnp.int32)),
+            num_nodes=st.num_nodes
+            + 2 * jnp.sum(valid, dtype=jnp.int32).astype(jnp.int32),
+            num_splits=st.num_splits
+            + jnp.sum(valid, dtype=jnp.int32).astype(jnp.int32))
+        return st
+
+    def _wave_body(self, st: WaveState, feature_mask) -> WaveState:
+        W, M, n = self.W, self.M, self.n_pad
+        fw = self.fw
+        # ---- select the wave: top-W positive-gain frontier leaves
+        g = self._pool_gains(st)
+        gv, wi = lax.top_k(g, W)
+        rem = self.budget - st.num_splits
+        valid = (gv > 0.0) & (jnp.arange(W) < rem)
+        pos = jnp.cumsum(valid.astype(jnp.int32)) - valid.astype(jnp.int32)
+        lslot = st.num_nodes + 2 * pos
+        rslot = lslot + 1
+        # ---- per-member split params (small gathers over node tables)
+        feat = st.cand_i[wi, CI_FEAT]
+        thr = st.cand_i[wi, CI_THR]
+        flags = st.cand_i[wi, CI_FLAGS]
+        dleft = (flags & 1).astype(jnp.float32)
+        iscat = ((flags & 2) >> 1).astype(jnp.float32)
+        ps = st.node_i[wi, 0]
+        cw = st.node_i[wi, 1]
+        col = self.fw_col[feat]
+        widx = col // 4
+        shift = (col % 4) * 8
+        mt = self.f_missing[feat]
+        db = self.f_default_bin[feat]
+        nb = self.f_num_bin[feat]
+        boff = self.fw_goff[feat]
+        bnd = self.fw_bnd[feat]
+        # ---- per-row params via MXU mask-matmul (gathers are ~5 ms/M rows
+        # on TPU, the one-hot contraction ~0.5 ms)
+        mask = (st.lid_p[:, None] == wi[None, :]) & valid[None, :]  # (N, W)
+        mask_f = mask.astype(jnp.float32)
+        P = jnp.stack([widx.astype(jnp.float32), shift.astype(jnp.float32),
+                       thr.astype(jnp.float32), dleft, iscat,
+                       mt.astype(jnp.float32), db.astype(jnp.float32),
+                       nb.astype(jnp.float32), boff.astype(jnp.float32),
+                       bnd.astype(jnp.float32), lslot.astype(jnp.float32),
+                       rslot.astype(jnp.float32), ps.astype(jnp.float32)],
+                      axis=1)                                       # (W, C)
+        pm = lax.dot_general(mask_f, P, (((1,), (0,)), ((), ())),
+                             precision=_HIGH)                       # (N, C)
+        in_wave = jnp.any(mask, axis=1)
+        ri = lambda c: jnp.rint(pm[:, c]).astype(jnp.int32)
+        widx_r, shift_r, thr_r = ri(0), ri(1), ri(2)
+        dleft_r = pm[:, 3] > 0.5
+        iscat_r = pm[:, 4] > 0.5
+        mt_r, db_r, nb_r = ri(5), ri(6), ri(7)
+        boff_r, bnd_r = ri(8), ri(9)
+        lslot_r, rslot_r, ps_r = ri(10), ri(11), ri(12)
+        # ---- per-row decision (NumericalDecisionInner `tree.h:233-249`)
+        word = jnp.zeros(n, jnp.int32)
+        for wdi in range(fw):
+            word = word + jnp.where(widx_r == wdi, st.bins_p[wdi], 0)
+        code = (word >> shift_r) & 0xFF
+        if self._bundle is not None:
+            r = code - boff_r
+            in_r = (r >= 0) & (r < nb_r - 1)
+            dec = r + (r >= db_r).astype(r.dtype)
+            frow = jnp.where(bnd_r == 1, jnp.where(in_r, dec, db_r), code)
+        else:
+            frow = code
+        is_missing = ((mt_r == MISSING_ZERO) & (frow == db_r)) | \
+                     ((mt_r == MISSING_NAN) & (frow == nb_r - 1))
+        go_left = jnp.where(is_missing, dleft_r, frow <= thr_r)
+        if self.has_categorical:
+            cb_w = st.cand_b[wi]                                # (W, Wc)
+            cat16 = jnp.concatenate(
+                [(cb_w & jnp.uint32(0xFFFF)).astype(jnp.float32),
+                 (cb_w >> jnp.uint32(16)).astype(jnp.float32)], axis=1)
+            catpm = lax.dot_general(mask_f, cat16, (((1,), (0,)), ((), ())),
+                                    precision=_HIGH)            # (N, 2*Wc)
+            j = frow >> 5
+            lo = jnp.zeros(n, jnp.float32)
+            hi = jnp.zeros(n, jnp.float32)
+            for jj in range(self.cat_W):
+                sel = j == jj
+                lo = lo + jnp.where(sel, catpm[:, jj], 0.0)
+                hi = hi + jnp.where(sel, catpm[:, self.cat_W + jj], 0.0)
+            catw = (jnp.rint(hi).astype(jnp.int32).astype(jnp.uint32)
+                    << jnp.uint32(16)) | \
+                jnp.rint(lo).astype(jnp.int32).astype(jnp.uint32)
+            cat_left = (catw >> (frow & 31).astype(jnp.uint32)) & 1
+            go_left = jnp.where(iscat_r, cat_left == 1, go_left)
+        go_left = go_left & in_wave
+        # ---- exact integer counts via f32-exact one-hot contractions
+        gl_f = go_left.astype(jnp.float32)
+        bag_f = (st.w_p[2] > 0.5).astype(jnp.float32)
+        cnt3 = lax.dot_general(
+            jnp.stack([gl_f, gl_f * bag_f, bag_f], 0), mask_f,
+            (((1,), (0,)), ((), ())), precision=_HIGH)          # (3, W)
+        lc_w = jnp.rint(cnt3[0]).astype(jnp.int32)
+        lc_bag = jnp.rint(cnt3[1]).astype(jnp.int32)
+        c_bag = jnp.rint(cnt3[2]).astype(jnp.int32)
+        # ---- window-order keys.  INVARIANT: every leaf's rows carry
+        # key = 2 * (its window start) — strictly increasing with position,
+        # so the stable sort is the identity on untouched leaves and
+        # partitions each split window in place.  During the sort the two
+        # children use 2s / 2s+1 (correct relative order: the next window
+        # starts at s' >= s+c, key 2s' > 2s+1); the right child's rows are
+        # re-keyed to their true start 2*(s+lc) right after.
+        key_p = jnp.where(in_wave,
+                          2 * ps_r + (~go_left & in_wave).astype(jnp.int32),
+                          st.key_p)
+        lid_p = jnp.where(in_wave,
+                          jnp.where(go_left, lslot_r, rslot_r), st.lid_p)
+        # ---- ONE stable sort re-compacts every split window
+        ops = ([key_p] + [st.bins_p[i] for i in range(fw)]
+               + [st.w_p[0], st.w_p[1], st.w_p[2], st.rid_p, lid_p])
+        sd = lax.sort(ops, num_keys=1, is_stable=True)
+        bins_p = jnp.stack(sd[1:1 + fw])
+        w_p = jnp.stack(sd[1 + fw:4 + fw])
+        rid_p, lid_p = sd[4 + fw], sd[5 + fw]
+        # restore the key invariant for the right children
+        rmask = (lid_p[:, None] == rslot[None, :]) & valid[None, :]
+        rkey = lax.dot_general(
+            rmask.astype(jnp.float32),
+            (2 * (ps + lc_w)).astype(jnp.float32),
+            (((1,), (0,)), ((), ())), precision=_HIGH)
+        key_p = jnp.where(jnp.any(rmask, axis=1),
+                          jnp.rint(rkey).astype(jnp.int32), sd[0])
+        st = st._replace(bins_p=bins_p, w_p=w_p, rid_p=rid_p, lid_p=lid_p,
+                         key_p=key_p)
+        # ---- child windows
+        li = jnp.stack([ps, lc_w], 1)
+        ri2 = jnp.stack([ps + lc_w, cw - lc_w], 1)
+        # ---- smaller-child histograms (+ sibling subtraction) per member
+        left_small = lc_bag <= (c_bag - lc_bag)
+        sm_slot = jnp.where(left_small, lslot, rslot)
+        sm_start = jnp.where(left_small, ps, ps + lc_w)
+        sm_cnt = jnp.where(left_small, lc_w, cw - lc_w)
+        ph = st.hslot[wi]
+        rh = 1 + st.num_splits + pos
+        oobh = jnp.int32(self.H + 7)
+        lh_w = jnp.where(valid, ph, oobh)
+        rh_w = jnp.where(valid, rh, oobh)
+
+        def hist_member(pool, xs):
+            slot, start, cnt, phk, lhk, rhk, lsm, vk = xs
+
+            def compute(pool):
+                hidx = self._bucket_idx(jnp.maximum(cnt, 1))
+                h_small = lax.switch(hidx, self._hist_branches, st.bins_p,
+                                     st.w_p, st.lid_p, start, cnt, slot)
+                h_par = pool[phk]
+                h_large = h_par - h_small
+                hl = jnp.where(lsm, h_small, h_large)
+                hr = jnp.where(lsm, h_large, h_small)
+                return pool.at[lhk].set(hl).at[rhk].set(hr), (hl, hr)
+
+            def skip(pool):
+                z = jnp.zeros_like(pool[0])
+                return pool, (z, z)
+
+            # a wave is W slots but only the valid prefix holds members —
+            # the cond keeps invalid slots from paying a histogram pass
+            return lax.cond(vk, compute, skip, pool)
+
+        pool, (hl, hr) = lax.scan(
+            hist_member, st.hist_pool,
+            (sm_slot, sm_start, sm_cnt, ph, lh_w, rh_w, left_small, valid))
+        st = st._replace(hist_pool=pool)
+        hists2 = jnp.stack([hl, hr], 1).reshape((2 * self.W,) + hl.shape[1:])
+        return self._children_bookkeeping(
+            st, wi, valid, lslot, rslot, lc_bag, c_bag, li, ri2, ph, rh,
+            hists2, feature_mask)
+
+    # -- the stall split (exact-replay correction) ---------------------------
+
+    def _make_stall_branch(self, S: int, sort_mode: bool):
+        """Partition of one window outside the wave flow, mirroring the
+        sequential compact learner exactly (`learner_compact.py`
+        ``_make_partition_branch``) except that BOTH children get fresh
+        node slots (the sequential learner reuses the parent's).
+
+        sort_mode: stable window sort physically compacts the children
+        (windows above ``tpu_sort_cutoff``).  Otherwise the window is
+        frozen and only lid lanes change; the sort_mode invariant matches
+        the sequential learner's — frozen (shared) windows are always
+        ≤ cutoff, so a sort-mode stall never reorders another leaf's rows.
+        """
+        fw, n = self.fw, self.n_pad
+
+        def branch(bins_p, w_p, rid_p, lid_p, s, c, leaf, feat, thr, dleft,
+                   is_cat, cat_bits, l0, r0):
+            sa = jnp.clip(s, 0, n - S).astype(jnp.int32)
+            off = (s - sa).astype(jnp.int32)
+            bw = lax.dynamic_slice(bins_p, (jnp.int32(0), sa), (fw, S))
+            ww = lax.dynamic_slice(w_p, (jnp.int32(0), sa), (3, S))
+            lid = lax.dynamic_slice(lid_p, (sa,), (S,))
+            pos = jnp.arange(S, dtype=jnp.int32)
+            in_seg = (pos >= off) & (pos < off + c) & (lid == leaf)
+            col = self.fw_col[feat]
+            word = lax.dynamic_slice(bw, (col // 4, jnp.int32(0)), (1, S))[0]
+            code = (word >> ((col % 4) * 8)) & 0xFF
+            if self._bundle is not None:
+                boffk = self.fw_goff[feat]
+                d = self.f_default_bin[feat]
+                r = code - boffk
+                in_r = (r >= 0) & (r < self.f_num_bin[feat] - 1)
+                dec = r + (r >= d).astype(r.dtype)
+                frow = jnp.where(self.fw_bnd[feat] == 1,
+                                 jnp.where(in_r, dec, d), code)
+            else:
+                frow = code
+            mtk = self.f_missing[feat]
+            dbk = self.f_default_bin[feat]
+            nbk = self.f_num_bin[feat]
+            is_missing = ((mtk == MISSING_ZERO) & (frow == dbk)) | \
+                         ((mtk == MISSING_NAN) & (frow == nbk - 1))
+            go_left = jnp.where(is_missing, dleft, frow <= thr)
+            if self.has_categorical:
+                cat_left = (cat_bits[frow >> 5]
+                            >> (frow & 31).astype(jnp.uint32)) & 1
+                go_left = jnp.where(is_cat, cat_left == 1, go_left)
+            bag = ww[2] > 0.5
+            segl = in_seg & go_left
+            lc_bag = jnp.sum((segl & bag).astype(jnp.int32))
+            c_bag = jnp.sum((in_seg & bag).astype(jnp.int32))
+            if sort_mode:
+                rid = lax.dynamic_slice(rid_p, (sa,), (S,))
+                key = jnp.where(in_seg,
+                                jnp.where(go_left, 1, 2),
+                                jnp.where(pos < off, 0, 3)).astype(jnp.int32)
+                lid2 = jnp.where(in_seg, jnp.where(go_left, l0, r0), lid)
+                ops = ([key] + [bw[i] for i in range(fw)]
+                       + [ww[0], ww[1], ww[2], rid, lid2])
+                sd = lax.sort(ops, num_keys=1, is_stable=True)
+                bw2 = jnp.stack(sd[1:1 + fw])
+                ww2 = jnp.stack(sd[1 + fw:4 + fw])
+                rid2, lid3 = sd[4 + fw], sd[5 + fw]
+                lc_w = jnp.sum(segl.astype(jnp.int32)).astype(jnp.int32)
+                bins_p = lax.dynamic_update_slice(bins_p, bw2,
+                                                  (jnp.int32(0), sa))
+                w_p = lax.dynamic_update_slice(w_p, ww2, (jnp.int32(0), sa))
+                rid_p = lax.dynamic_update_slice(rid_p, rid2, (sa,))
+                lid_p = lax.dynamic_update_slice(lid_p, lid3, (sa,))
+                ls, lw = s, lc_w
+                rs, rw = s + lc_w, c - lc_w
+            else:
+                lid2 = jnp.where(in_seg, jnp.where(go_left, l0, r0), lid)
+                lid_p = lax.dynamic_update_slice(lid_p, lid2, (sa,))
+                ls = rs = s
+                lw = rw = c
+            return (bins_p, w_p, rid_p, lid_p, ls, lw, rs, rw,
+                    lc_bag.astype(jnp.int32), c_bag.astype(jnp.int32))
+
+        return branch
+
+    def _stall_split(self, st: WaveState, top, feature_mask) -> WaveState:
+        """Split one frontier leaf outside the wave flow."""
+        crow_i = st.cand_i[top]
+        feat = crow_i[CI_FEAT]
+        thr = crow_i[CI_THR]
+        dleft = (crow_i[CI_FLAGS] & 1) == 1
+        is_cat = (crow_i[CI_FLAGS] & 2) == 2
+        cat_bits = st.cand_b[top]
+        s = st.node_i[top, 0]
+        c = st.node_i[top, 1]
+        l0 = st.num_nodes
+        r0 = l0 + 1
+        pidx = self._bucket_idx(c)
+        bins_p, w_p, rid_p, lid_p, ls, lw, rs, rw, lc_bag, c_bag = \
+            lax.switch(pidx, self._stall_branches, st.bins_p, st.w_p,
+                       st.rid_p, st.lid_p, s, c, top, feat, thr, dleft,
+                       is_cat, cat_bits, l0, r0)
+        st = st._replace(bins_p=bins_p, w_p=w_p, rid_p=rid_p, lid_p=lid_p)
+        # smaller-child histogram + sibling subtraction
+        left_small = lc_bag <= (c_bag - lc_bag)
+        sm_slot = jnp.where(left_small, l0, r0)
+        sm_start = jnp.where(left_small, ls, rs)
+        sm_cnt = jnp.where(left_small, lw, rw)
+        hidx = self._bucket_idx(jnp.maximum(sm_cnt, 1))
+        h_small = lax.switch(hidx, self._hist_branches, st.bins_p, st.w_p,
+                             st.lid_p, sm_start, sm_cnt, sm_slot)
+        ph = st.hslot[top]
+        h_par = st.hist_pool[ph]
+        h_large = h_par - h_small
+        hl = jnp.where(left_small, h_small, h_large)
+        hr = jnp.where(left_small, h_large, h_small)
+        rh = 1 + st.num_splits
+        st = st._replace(hist_pool=st.hist_pool.at[ph].set(hl)
+                         .at[rh].set(hr))
+        one = jnp.ones(1, bool)
+        li = jnp.stack([ls, lw])[None, :]
+        ri = jnp.stack([rs, rw])[None, :]
+        return self._children_bookkeeping(
+            st, top[None], one, l0[None], r0[None],
+            lc_bag[None], c_bag[None], li, ri, ph[None], rh[None],
+            jnp.stack([hl, hr]), feature_mask)
+
+    # -- exact greedy replay --------------------------------------------------
+
+    def _replay(self, st: WaveState, feature_mask):
+        """Re-derive the exact best-first pop order over the grown forest
+        (`serial_tree_learner.cpp:185-218`), splitting on demand when the
+        replay reaches a leaf the growth never split."""
+        M, budget = self.M, self.budget
+
+        def cond(carry):
+            return ~carry[-1]
+
+        def body(carry):
+            st, avail, refidx, pops, leaf_cnt, pop_nodes, pop_ref, stop = \
+                carry
+            g = jnp.where(avail, st.cand_f[:, CF_GAIN], -jnp.inf)
+            mg = jnp.max(g)
+            proceed = (mg > 0.0) & (pops < budget)
+            # lowest-leaf-index tie-break (`serial_tree_learner.cpp:505`)
+            tb = jnp.where(g == mg, refidx, jnp.int32(1 << 30))
+            top = jnp.argmin(tb).astype(jnp.int32)
+            need_split = proceed & ~st.split_m[top]
+
+            def do_stall(st):
+                return self._stall_split(st, top, feature_mask)
+
+            st = lax.cond(need_split, do_stall, lambda s: s, st)
+
+            def do_pop(args):
+                avail, refidx, pops, leaf_cnt, pop_nodes, pop_ref = args
+                c0 = st.child0[top]
+                avail = avail.at[top].set(False) \
+                    .at[c0].set(True).at[c0 + 1].set(True)
+                refidx = refidx.at[c0].set(refidx[top]) \
+                    .at[c0 + 1].set(leaf_cnt)
+                pop_nodes = pop_nodes.at[pops].set(top)
+                pop_ref = pop_ref.at[pops].set(refidx[top])
+                return avail, refidx, pops + 1, leaf_cnt + 1, pop_nodes, \
+                    pop_ref
+
+            can_pop = proceed & ~need_split
+            args = (avail, refidx, pops, leaf_cnt, pop_nodes, pop_ref)
+            avail, refidx, pops, leaf_cnt, pop_nodes, pop_ref = lax.cond(
+                can_pop, do_pop, lambda a: a, args)
+            stop = ~proceed | (pops >= budget)
+            return (st, avail, refidx, pops, leaf_cnt, pop_nodes, pop_ref,
+                    stop)
+
+        init = (st,
+                jnp.zeros(M, bool).at[0].set(True),
+                jnp.full(M, -1, jnp.int32).at[0].set(0),
+                jnp.asarray(0, jnp.int32),
+                jnp.asarray(1, jnp.int32),
+                jnp.zeros(budget, jnp.int32),
+                jnp.zeros(budget, jnp.int32),
+                jnp.asarray(False))
+        st, avail, refidx, pops, leaf_cnt, pop_nodes, pop_ref, _ = \
+            lax.while_loop(cond, body, init)
+        return st, avail, refidx, pops, pop_nodes, pop_ref
+
+    # -- whole tree -----------------------------------------------------------
+
+    def _train_tree_wave(self, bins_p, grad, hess, bag, feature_mask):
+        self._hist_branches = [self._make_hist_branch(S)
+                               for S in self._win_sizes]
+        self._stall_branches = [
+            self._make_stall_branch(S, sort_mode=S > self._sort_cutoff)
+            for S in self._win_sizes]
+        st = self._init_root_wave(bins_p, grad, hess, bag, feature_mask)
+
+        def gcond(s):
+            return (s.num_splits < self.budget) & \
+                (jnp.max(self._pool_gains(s)) > 0.0)
+
+        st = lax.while_loop(gcond, lambda s: self._wave_body(s, feature_mask),
+                            st)
+        st, avail, refidx, pops, pop_nodes, pop_ref = self._replay(
+            st, feature_mask)
+
+        # ---- emit host records in pop order
+        budget = self.budget
+        vp = jnp.arange(budget) < pops
+        nd = jnp.where(vp, pop_nodes, 0)
+        cf = st.cand_f[nd].astype(jnp.float32)
+        ci = st.cand_i[nd]
+        nf = st.node_f[nd].astype(jnp.float32)
+        rec_f = jnp.stack([
+            vp.astype(jnp.float32),
+            pop_ref.astype(jnp.float32),
+            ci[:, CI_FEAT].astype(jnp.float32),
+            ci[:, CI_THR].astype(jnp.float32),
+            (ci[:, CI_FLAGS] & 1).astype(jnp.float32),
+            cf[:, CF_GAIN],
+            cf[:, CF_LOUT], cf[:, CF_ROUT],
+            cf[:, CF_LCNT], cf[:, CF_RCNT],
+            nf[:, LF_OUT], nf[:, LF_CNT],
+            cf[:, CF_LSH], cf[:, CF_RSH],
+            cf[:, CF_LSG], cf[:, CF_RSG],
+            ((ci[:, CI_FLAGS] & 2) >> 1).astype(jnp.float32)], axis=1)
+        assert rec_f.shape[1] == NUM_REC_FIELDS
+        rec_i = st.cnt_i[nd]
+        rec_cat = st.cand_b[nd]
+
+        # ---- map speculative leaves to their final ancestors
+        final = avail  # revealed and never popped
+        iota = jnp.arange(self.M, dtype=jnp.int32)
+        T = jnp.where(final, iota, st.parent)
+        # pointer-jump doubling: k iterations cover chains of 2^k; chain
+        # depth is bounded by the node count M
+        for _ in range(max(1, (self.M - 1).bit_length())):
+            T = T[T]
+        slot2ref = jnp.where(final[T], refidx[T], 0)
+        leaf_ref = lookup_int(slot2ref, st.lid_p)
+        leaf_id = jnp.zeros(self.n_pad, jnp.int32).at[st.rid_p].set(leaf_ref)
+        leaf_out = jnp.zeros(self.num_leaves, jnp.float32).at[
+            jnp.where(final, refidx, self.num_leaves + 7)].set(
+                st.node_f[:, LF_OUT].astype(jnp.float32))
+        return rec_f, rec_i, rec_cat, leaf_id, leaf_out
+
+    # -- host orchestration ---------------------------------------------------
+
+    def train_async(self, grad: jax.Array, hess: jax.Array, bag: jax.Array,
+                    feature_mask: Optional[jax.Array] = None):
+        if feature_mask is None:
+            feature_mask = jnp.ones(self.num_features, dtype=bool)
+        return self._jit_tree_w(self.bins_packed(), grad, hess, bag,
+                                feature_mask)
+
+
+def wave_eligible(cfg: Config, data: _ConstructedDataset) -> bool:
+    """Gates for the wave learner; ineligible configs use the sequential
+    compact learner.  Sizing uses the BUNDLED (EFB) column layout when a
+    bundle exists — that is what the learner actually runs on."""
+    if cfg.tree_learner != "serial" or data.max_num_bin > 256:
+        return False
+    if int(data.num_data_padded) >= (1 << 24):
+        return False  # f32-exact count contractions need N < 2^24
+    bundle = getattr(data, "bundle", None)
+    if bundle is not None:
+        from .dataset import _round_up
+        f_pad = _round_up(bundle.num_groups, data.FEATURE_TILE)
+        b = max(int(data.max_num_bin), int(bundle.max_group_bin))
+        if b > 256:
+            return False
+    else:
+        f_pad = data.bins.shape[0]
+        b = int(data.max_num_bin)
+    if f_pad // 4 > 64:
+        return False  # per-row word extraction is a masked sum over words
+    budget = max(int(cfg.num_leaves), 2) - 1
+    h_bytes = (2 * budget + 2) * f_pad * b * 3 * 4
+    scan_bytes = 2 * min(int(cfg.tpu_wave_width), budget) * f_pad * b * 3 * 4
+    return h_bytes + scan_bytes <= int(cfg.tpu_wave_max_bytes)

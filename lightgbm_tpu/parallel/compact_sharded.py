@@ -56,6 +56,8 @@ class ShardedCompactLearner(CompactTPUTreeLearner):
     learner.  One row shard per device; histograms reduce-scattered over
     features."""
 
+    _supports_bundle = False
+
     def __init__(self, cfg: Config, data: _ConstructedDataset, mesh: Mesh,
                  hist_backend: str = "auto"):
         self.mesh = mesh

@@ -1,0 +1,124 @@
+"""Alternatives to per-row small-table gathers (the 5 ms/M-row poison).
+
+Tested in-program (chained inside one jit):
+  * plain ``table[lid]`` gather, int32 and f32 tables, M=256/768
+  * one-hot matmul lookup: ``one_hot(lid, M) @ table`` (MXU)
+  * per-row remap via equality masked-sum over a SMALL set of changed
+    entries (the incremental-update trick)
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def timed(fn, *args, iters=20):
+    import jax
+    r = fn(*args)
+    np.asarray(jax.tree_util.tree_leaves(r)[0].ravel()[0])
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            r = fn(*args)
+        np.asarray(jax.tree_util.tree_leaves(r)[0].ravel()[0])
+        best = min(best, (time.perf_counter() - t0) / iters)
+    return best
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    S = int(sys.argv[1]) if len(sys.argv) > 1 else 1_048_576
+    K = 8
+    rng = np.random.RandomState(0)
+
+    def bench(name, make, *args):
+        base = timed(make(0), *args)
+        t = timed(make(K), *args)
+        print(f"{name:34s} {(t-base)/K*1e3:7.2f} ms/op")
+
+    for M in (256, 768):
+        lid = jnp.asarray(rng.randint(0, M, S).astype(np.int32))
+        ti = jnp.asarray(rng.randint(0, 255, M).astype(np.int32))
+        tf = jnp.asarray(rng.randn(M).astype(np.float32))
+
+        def make_gi(k):
+            def f(lid, t):
+                acc = jnp.zeros_like(lid)
+                for i in range(k):
+                    acc = acc + t[jnp.minimum(lid + (acc & 1), M - 1)]
+                return acc
+            return jax.jit(f)
+
+        def make_gf(k):
+            def f(lid, t):
+                acc = jnp.zeros(S, jnp.float32)
+                for i in range(k):
+                    acc = acc + t[jnp.minimum(lid + (acc > 0), M - 1)]
+                return acc
+            return jax.jit(f)
+
+        def make_oh(k):
+            def f(lid, t):
+                acc = jnp.zeros(S, jnp.float32)
+                for i in range(k):
+                    oh = jax.nn.one_hot(
+                        jnp.minimum(lid + (acc > 0), M - 1), M,
+                        dtype=jnp.bfloat16)
+                    acc = acc + jnp.dot(
+                        oh, t.astype(jnp.bfloat16),
+                        preferred_element_type=jnp.float32)
+                return acc
+            return jax.jit(f)
+
+        bench(f"gather int32 M={M}", make_gi, lid, ti)
+        bench(f"gather f32   M={M}", make_gf, lid, tf)
+        bench(f"one-hot matmul M={M}", make_oh, lid, tf)
+
+    # incremental remap: values change for only Wc entries per wave —
+    # update per-row values with Wc selects instead of a fresh gather
+    M = 768
+    Wc = 128
+    lid = jnp.asarray(rng.randint(0, M, S).astype(np.int32))
+    vals = jnp.asarray(rng.randn(S).astype(np.float32))
+    sel = jnp.asarray(rng.choice(M, Wc, replace=False).astype(np.int32))
+    nv = jnp.asarray(rng.randn(Wc).astype(np.float32))
+
+    def make_inc(k):
+        def f(lid, vals, sel, nv):
+            acc = vals
+            for i in range(k):
+                upd = jnp.zeros(S, jnp.float32)
+                hit = jnp.zeros(S, bool)
+                for j in range(Wc):
+                    m = lid == sel[j]
+                    hit = hit | m
+                    upd = jnp.where(m, nv[j], upd)
+                acc = jnp.where(hit, upd, acc)
+            return acc
+        return jax.jit(f)
+
+    def make_inc_mm(k):
+        def f(lid, vals, sel, nv):
+            acc = vals
+            for i in range(k):
+                m = (lid[:, None] == sel[None, :])
+                upd = jnp.dot(m.astype(jnp.bfloat16), nv.astype(jnp.bfloat16),
+                              preferred_element_type=jnp.float32)
+                acc = jnp.where(jnp.any(m, axis=1), upd, acc)
+            return acc
+        return jax.jit(f)
+
+    bench(f"incremental {Wc} selects", make_inc, lid, vals, sel, nv)
+    bench(f"incremental {Wc} mask-matmul", make_inc_mm, lid, vals, sel, nv)
+
+
+if __name__ == "__main__":
+    main()

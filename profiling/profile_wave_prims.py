@@ -1,0 +1,169 @@
+"""Microbench of the wave-learner's building blocks on the real TPU.
+
+The frontier-wave redesign replaces 254 per-split window sorts with ~13
+per-wave prefix sorts plus per-row table lookups.  This measures:
+
+  * small-table gathers (split params per row: ``table[lid]``)
+  * per-row packed-word extraction (``take_along_axis`` on the word axis)
+  * prefix sorts at shrinking sizes (the active-prefix schedule)
+  * int8 mask matmul for exact bagged counts
+  * while_loop + cond dispatch overhead (the greedy-sim replay loop)
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def timed(fn, *args, iters=30):
+    import jax
+    r = fn(*args)
+    np.asarray(jax.tree_util.tree_leaves(r)[0].ravel()[0])
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            r = fn(*args)
+        np.asarray(jax.tree_util.tree_leaves(r)[0].ravel()[0])
+        best = min(best, (time.perf_counter() - t0) / iters)
+    return best
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    S = int(sys.argv[1]) if len(sys.argv) > 1 else 1_048_576
+    fw = 8
+    M = 768
+    W = 64
+    rng = np.random.RandomState(0)
+    lid = jnp.asarray(rng.randint(0, M, S).astype(np.int32))
+    table = jnp.asarray(rng.randint(0, 255, M).astype(np.int32))
+    bins = jnp.asarray(rng.randint(0, 2**31, (fw, S)).astype(np.int32))
+    w3 = jnp.asarray(rng.randn(3, S).astype(np.float32))
+    rid = jnp.arange(S, dtype=jnp.int32)
+    widx = jnp.asarray(rng.randint(0, fw, S).astype(np.int32))
+    wave_slots = jnp.asarray(rng.choice(M, W, replace=False).astype(np.int32))
+    bag = jnp.asarray((rng.rand(S) > 0.2).astype(np.int8))
+
+    @jax.jit
+    def table_gather_x6(lid, table):
+        a = table[lid]
+        b = table[lid + 1]
+        c = table[jnp.minimum(lid + 2, M - 1)]
+        d = table[jnp.minimum(lid + 3, M - 1)]
+        e = table[jnp.minimum(lid + 4, M - 1)]
+        f = table[jnp.minimum(lid + 5, M - 1)]
+        return a + b + c + d + e + f
+
+    @jax.jit
+    def word_extract_taa(bins, widx):
+        return jnp.take_along_axis(bins, widx[None, :], axis=0)[0]
+
+    @jax.jit
+    def word_extract_msum(bins, widx):
+        acc = jnp.zeros_like(bins[0])
+        for w in range(fw):
+            acc = acc + jnp.where(widx == w, bins[w], 0)
+        return acc
+
+    @jax.jit
+    def mask_matmul_int8(lid, bag, wave_slots):
+        m = (lid[None, :] == wave_slots[:, None]).astype(jnp.int8)
+        return lax.dot_general(
+            m, bag[:, None], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32)
+
+    def make_prefix_sort(Sp):
+        def f(key, bins, w3, rid, lid):
+            kw = lax.dynamic_slice(key, (0,), (Sp,))
+            bw = lax.dynamic_slice(bins, (0, 0), (fw, Sp))
+            ww = lax.dynamic_slice(w3, (0, 0), (3, Sp))
+            rw = lax.dynamic_slice(rid, (0,), (Sp,))
+            lw = lax.dynamic_slice(lid, (0,), (Sp,))
+            ops = [kw] + [bw[i] for i in range(fw)] + [ww[i] for i in range(3)] \
+                + [rw, lw]
+            out = lax.sort(ops, num_keys=1, is_stable=True)
+            return out[1]
+        return jax.jit(f)
+
+    @jax.jit
+    def sim_loop(gains, child):
+        # greedy replay: 254 pops over (M,) gains with avail mask updates
+        def body(c):
+            i, avail, total, pops = c
+            g = jnp.where(avail, gains, -jnp.inf)
+            top = jnp.argmax(g).astype(jnp.int32)
+            avail = avail.at[top].set(False)
+            avail = avail.at[child[top]].set(True)
+            avail = avail.at[child[top] + 1].set(True)
+            pops = pops.at[i].set(top)
+            return (i + 1, avail, total + g[top], pops)
+
+        def cond(c):
+            return c[0] < 254
+
+        init = (jnp.int32(0), jnp.zeros(M, bool).at[0].set(True),
+                jnp.float32(0), jnp.zeros(254, jnp.int32))
+        return lax.while_loop(cond, body, init)[2]
+
+    @jax.jit
+    def sim_loop_cond(gains, child, big):
+        # same but with a lax.cond branch touching a big array each step
+        def heavy(big, top):
+            return big.at[0, top].add(1.0)
+
+        def light(big, top):
+            return big
+
+        def body(c):
+            i, avail, total, big = c
+            g = jnp.where(avail, gains, -jnp.inf)
+            top = jnp.argmax(g).astype(jnp.int32)
+            avail = avail.at[top].set(False)
+            avail = avail.at[child[top]].set(True)
+            avail = avail.at[child[top] + 1].set(True)
+            big = lax.cond(top % 17 == 0, heavy, light, big, top)
+            return (i + 1, avail, total + g[top], big)
+
+        def cond(c):
+            return c[0] < 254
+
+        init = (jnp.int32(0), jnp.zeros(M, bool).at[0].set(True),
+                jnp.float32(0), big)
+        return lax.while_loop(cond, body, init)[2]
+
+    key = table[lid]
+    gains = jnp.asarray(rng.rand(M).astype(np.float32))
+    child = jnp.asarray(
+        np.minimum(np.arange(M) * 2 + 1, M - 2).astype(np.int32))
+    big = w3
+
+    print(f"S={S}")
+    for name, fn, args in [
+        ("table gather x6", table_gather_x6, (lid, table)),
+        ("word take_along_axis", word_extract_taa, (bins, widx)),
+        ("word masked-sum fw=8", word_extract_msum, (bins, widx)),
+        ("mask matmul int8 W=64", mask_matmul_int8, (lid, bag, wave_slots)),
+        ("sim while_loop 254", sim_loop, (gains, child)),
+        ("sim while+cond 254", sim_loop_cond, (gains, child, big)),
+    ]:
+        t = timed(fn, *args)
+        print(f"{name:24s} {t*1e3:9.2f} ms")
+
+    for frac in (1.0, 0.5, 0.25, 0.125, 0.0625):
+        Sp = max(1024, int(S * frac))
+        Sp = 1 << (Sp - 1).bit_length()
+        fn = make_prefix_sort(min(Sp, S))
+        t = timed(fn, key, bins, w3, rid, lid)
+        print(f"prefix sort 14ops S={min(Sp, S):8d} {t*1e3:9.2f} ms")
+
+
+if __name__ == "__main__":
+    main()

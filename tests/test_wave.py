@@ -1,0 +1,193 @@
+"""Frontier-wave learner ≡ sequential compact learner.
+
+The wave learner (`learner_wave.py`) batches leaf-wise growth into
+speculative frontier waves and trims back to exact best-first semantics
+with a greedy replay.  With ``tpu_sort_cutoff=0`` the sequential compact
+learner compacts every window too, and the two must agree BIT-EXACTLY
+(same split sequence, same histograms, same leaf values); with the default
+cutoff the physical row alignment differs so agreement is to float
+tolerance.
+"""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.learner_wave import WaveTPUTreeLearner
+
+
+def _train(params, X, y, rounds=5, **dskw):
+    ds = lgb.Dataset(X, label=y, params=params, **dskw)
+    bst = lgb.Booster(params, ds)
+    for _ in range(rounds):
+        bst.update()
+    return bst
+
+
+def _models_equal(pa, pb, X, y, rounds=5, exact=True, **dskw):
+    a = _train(pa, X, y, rounds, **dskw)
+    b = _train(pb, X, y, rounds, **dskw)
+    assert isinstance(b.gbdt.learner, WaveTPUTreeLearner), \
+        type(b.gbdt.learner).__name__
+    if exact:
+        assert a.model_to_string() == b.model_to_string()
+    else:
+        a.model_to_string(), b.model_to_string()  # flush lazy assembly
+        for ta, tb in zip(a.gbdt._models, b.gbdt._models):
+            np.testing.assert_array_equal(ta.split_feature, tb.split_feature)
+            np.testing.assert_array_equal(ta.threshold_in_bin,
+                                          tb.threshold_in_bin)
+            np.testing.assert_allclose(
+                ta.leaf_value[:ta.num_leaves], tb.leaf_value[:tb.num_leaves],
+                rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(a.predict(X), b.predict(X), rtol=1e-4,
+                               atol=1e-5)
+    return a, b
+
+
+def _pair(**over):
+    base = {"objective": "binary", "num_leaves": 31, "max_bin": 63,
+            "min_data_in_leaf": 20, "verbosity": -1, "metric": "none",
+            "tpu_sort_cutoff": 0}
+    base.update(over)
+    return dict(base, tpu_learner="compact"), dict(base, tpu_learner="wave")
+
+
+def _make(n=20000, f=10, seed=3):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    y = (X[:, 0] + X[:, 1] * X[:, 2] + 0.3 * rng.randn(n) > 0).astype(float)
+    return X, y
+
+
+def test_wave_binary_exact():
+    X, y = _make()
+    _models_equal(*_pair(), X=X, y=y)
+
+
+def test_wave_default_cutoff_tolerance():
+    # with the default sort cutoff the compact learner's small windows are
+    # mask-mode (different summation alignment) — same splits, float-level
+    # leaf values
+    X, y = _make()
+    pa, pb = _pair()
+    del pa["tpu_sort_cutoff"], pb["tpu_sort_cutoff"]
+    _models_equal(pa, pb, X, y, exact=False)
+
+
+def test_wave_bagging_feature_fraction():
+    X, y = _make()
+    pa, pb = _pair(bagging_fraction=0.6, bagging_freq=1,
+                   feature_fraction=0.7, seed=7)
+    _models_equal(pa, pb, X, y)
+
+
+def test_wave_regression_l1_and_leaf_partition():
+    # regression_l1 renews leaf outputs through the learner's leaf_id
+    # partition — exercises the wave learner's speculative-leaf remap
+    rng = np.random.RandomState(5)
+    X = rng.randn(8000, 8)
+    y = X[:, 0] * 2 + np.abs(X[:, 1]) + 0.1 * rng.randn(8000)
+    pa, pb = _pair(objective="regression_l1", num_leaves=63)
+    _models_equal(pa, pb, X, y)
+
+
+def test_wave_monotone():
+    rng = np.random.RandomState(11)
+    X = rng.randn(6000, 5)
+    y = 2 * X[:, 0] - X[:, 1] + 0.2 * rng.randn(6000)
+    pa, pb = _pair(objective="regression",
+                   monotone_constraints=[1, -1, 0, 0, 0])
+    _models_equal(pa, pb, X, y)
+
+
+def test_wave_categorical():
+    rng = np.random.RandomState(13)
+    n = 12000
+    Xn = rng.randn(n, 3)
+    c1 = rng.randint(0, 12, n)
+    c2 = rng.randint(0, 40, n)
+    X = np.column_stack([Xn, c1, c2])
+    y = ((c1 % 3 == 0).astype(float) * 1.5 + Xn[:, 0]
+         + (c2 > 20) + 0.3 * rng.randn(n) > 1).astype(float)
+    pa, pb = _pair(max_cat_to_onehot=8)
+    _models_equal(pa, pb, X, y, categorical_feature=[3, 4])
+
+
+def test_wave_efb_bundles():
+    rng = np.random.RandomState(17)
+    n = 10000
+    dense = rng.randn(n, 2)
+    # mutually exclusive sparse block -> bundled by EFB
+    sparse = np.zeros((n, 6))
+    which = rng.randint(0, 6, n)
+    rows = np.arange(n)
+    sparse[rows, which] = rng.rand(n)
+    sparse[rng.rand(n) < 0.5, :] = 0.0
+    X = np.column_stack([dense, sparse])
+    y = (dense[:, 0] + sparse.sum(1) + 0.2 * rng.randn(n) > 0.5).astype(float)
+    pa, pb = _pair(enable_bundle=True)
+    a, b = _models_equal(pa, pb, X, y)
+    assert b.gbdt.learner._bundle is not None  # EFB actually active
+
+
+def test_wave_multiclass():
+    rng = np.random.RandomState(19)
+    X = rng.randn(9000, 6)
+    y = (X[:, 0] + X[:, 1] > 0).astype(int) + (X[:, 2] > 0.5).astype(int)
+    pa, pb = _pair(objective="multiclass", num_class=3, num_leaves=15)
+    _models_equal(pa, pb, X, y, rounds=3)
+
+
+def test_wave_goss_dart():
+    X, y = _make(12000)
+    for boosting in ("goss", "dart"):
+        pa, pb = _pair(boosting=boosting, seed=3)
+        _models_equal(pa, pb, X, y, rounds=4)
+
+
+def test_wave_exhausts_splits_early():
+    # more leaves than splittable data: growth stops on no positive gain
+    rng = np.random.RandomState(23)
+    X = rng.randn(400, 4)
+    y = (X[:, 0] > 0).astype(float)
+    pa, pb = _pair(num_leaves=255, min_data_in_leaf=30)
+    a, b = _models_equal(pa, pb, X, y, rounds=3)
+    assert a.gbdt._models[0].num_leaves < 255
+
+
+def test_wave_tiny_num_leaves():
+    X, y = _make(4000)
+    pa, pb = _pair(num_leaves=2)
+    _models_equal(pa, pb, X, y, rounds=3)
+
+
+def test_wave_max_depth():
+    X, y = _make(10000)
+    pa, pb = _pair(max_depth=4, num_leaves=63)
+    _models_equal(pa, pb, X, y)
+
+
+def test_wave_width_invariance():
+    # the trimmed tree must not depend on the wave width
+    X, y = _make(8000)
+    _, p1 = _pair(tpu_wave_width=4)
+    _, p2 = _pair(tpu_wave_width=64)
+    a = _train(p1, X, y)
+    b = _train(p2, X, y)
+    assert a.model_to_string() == b.model_to_string()
+
+
+def test_wave_exact_counts():
+    X, y = _make(15000)
+    _, pb = _pair(bagging_fraction=0.5, bagging_freq=1, seed=9)
+    b = _train(pb, X, y, rounds=2)
+    b.model_to_string()  # flush lazy assembly
+    for t in b.gbdt._models:
+        ni = t.num_leaves - 1
+        lc = np.asarray(t.internal_count[:ni])
+        for nd in range(ni):
+            l, r = t.left_child[nd], t.right_child[nd]
+            lcnt = t.leaf_count[~l] if l < 0 else t.internal_count[l]
+            rcnt = t.leaf_count[~r] if r < 0 else t.internal_count[r]
+            assert lc[nd] == lcnt + rcnt
