@@ -333,6 +333,12 @@ class Config:
     # histograms; configs that exceed it fall back to the sequential
     # compact learner
     tpu_wave_max_bytes: int = 1 << 31
+    # wave members whose window is at or below this size split in place
+    # (lid-lane rewrite, children share the parent span) instead of joining
+    # the global re-compaction sort; a wave with no sortable member skips
+    # the sort entirely — the sort is the wave learner's top cost and the
+    # tree's bottom waves are all small windows
+    tpu_wave_sort_cutoff: int = 8192
 
     # derived (not user-settable)
     is_parallel: bool = field(default=False, repr=False)

@@ -31,7 +31,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .binning import MISSING_NAN, MISSING_ZERO
+from .binning import MISSING_NAN, MISSING_NONE, MISSING_ZERO
 from .config import Config
 from .dataset import _ConstructedDataset
 from .ops.histogram import build_histogram
@@ -155,9 +155,13 @@ class TPUTreeLearner:
             max_delta_step=float(cfg.max_delta_step),
             min_data_in_leaf=int(cfg.min_data_in_leaf),
             min_sum_hessian_in_leaf=float(cfg.min_sum_hessian_in_leaf),
-            min_gain_to_split=float(cfg.min_gain_to_split))
+            min_gain_to_split=float(cfg.min_gain_to_split),
+            # all-MISSING_NONE datasets statically skip the whole
+            # missing-right scan (exact: it can contribute nothing)
+            skip_missing_scan=not bool((missing != MISSING_NONE).any()))
         self._cat_split_kwargs = dict(
-            self._split_kwargs,
+            {k: v for k, v in self._split_kwargs.items()
+             if k != "skip_missing_scan"},
             cat_l2=float(cfg.cat_l2), cat_smooth=float(cfg.cat_smooth),
             max_cat_threshold=int(cfg.max_cat_threshold),
             max_cat_to_onehot=int(cfg.max_cat_to_onehot),

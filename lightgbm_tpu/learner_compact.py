@@ -560,8 +560,9 @@ class CompactTPUTreeLearner(TPUTreeLearner):
 
         state = jax.lax.fori_loop(0, self.num_leaves - 1, body, state)
         # leaf partition in ORIGINAL row order for the score updater
-        leaf_id = jnp.zeros(self.n_pad, jnp.int32).at[state.rid_p].set(
-            state.lid_p)
+        # descatter to original row order via a 2-lane sort (~3x cheaper
+        # than the equivalent scatter on TPU)
+        leaf_id = lax.sort([state.rid_p, state.lid_p], num_keys=1)[1]
         leaf_output = state.leaf_f[:, LF_OUT].astype(jnp.float32)
         return (state.rec_f, state.rec_i, state.rec_cat, leaf_id,
                 leaf_output)

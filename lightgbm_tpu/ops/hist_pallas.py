@@ -204,6 +204,124 @@ def build_histogram_packed(bins_words: jax.Array, w: jax.Array, *,
     return out[:, :, :num_bins].transpose(0, 2, 1)
 
 
+# ---------------------------------------------------------------------------
+# Segment (multi-window) kernel for the frontier-wave learner.
+#
+# One wave needs the smaller-child histogram of up to W split members at
+# once; windows are arbitrary disjoint ranges of the leaf-compacted row
+# axis.  Instead of W sequential dynamic-slice dispatches (~0.15 ms of
+# switch+launch infra each), the wave issues ONE call whose grid walks a
+# scalar-prefetched chunk list: chunk t reads row-block ``block[t]`` of the
+# full array, masks rows by ``lid == leaf[t]``, and accumulates into output
+# slot ``slot[t]``.  Chunks are member-major so slot revisits are
+# consecutive (the standard Pallas reduction pattern); tail padding uses
+# slot == n_slots and is skipped entirely (its block-0 DMA is the only
+# cost).  Boundary blocks shared by two members appear once per member —
+# the lid mask makes the split exact regardless of alignment.
+# ---------------------------------------------------------------------------
+
+
+def _hist_kernel_segment(slot_ref, block_ref, leaf_ref, bins_ref, w_ref,
+                         lid_ref, out_ref, *, num_bins_padded: int,
+                         word_tile: int, nterms: int, n_slots: int):
+    t = pl.program_id(1)
+    slot = slot_ref[t]
+    prev = slot_ref[jnp.maximum(t - 1, 0)]
+    first = (t == 0) | (slot != prev)
+
+    @pl.when(slot < n_slots)
+    def _compute():
+        @pl.when(first)
+        def _init():
+            out_ref[...] = jnp.zeros_like(out_ref)
+
+        leaf = leaf_ref[t]
+        lid_blk = lid_ref[...]
+        m = (lid_blk == leaf).astype(jnp.float32)[None, :]
+        w_blk = w_ref[...] * m                      # (3, Rb) masked
+        rb = w_blk.shape[1]
+        if nterms > 0:
+            terms = []
+            resid = w_blk
+            for _ in range(nterms):
+                tt = resid.astype(jnp.bfloat16)
+                terms.append(tt)
+                resid = resid - tt.astype(jnp.float32)
+        iota_b = jax.lax.broadcasted_iota(jnp.int32, (num_bins_padded, rb), 0)
+        for wd in range(word_tile):
+            word = bins_ref[wd, :]
+            for sub in range(4):
+                row = (word >> (8 * sub)) & 0xFF
+                if nterms > 0:
+                    onehot = (row[None, :] == iota_b).astype(jnp.bfloat16)
+                    part = jax.lax.dot_general(
+                        terms[0], onehot, (((1,), (1,)), ((), ())),
+                        preferred_element_type=jnp.float32)
+                    for tm in terms[1:]:
+                        part += jax.lax.dot_general(
+                            tm, onehot, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+                else:
+                    onehot = (row[None, :] == iota_b).astype(jnp.float32)
+                    part = jax.lax.dot_general(
+                        w_blk, onehot, (((1,), (1,)), ((), ())),
+                        preferred_element_type=jnp.float32,
+                        precision=jax.lax.Precision.HIGHEST)
+                out_ref[0, wd * 4 + sub, :, :] += part
+
+
+@functools.partial(jax.jit, static_argnames=("num_bins", "n_slots",
+                                             "word_tile", "row_block",
+                                             "nterms", "interpret"))
+def build_histogram_segments(bins_words: jax.Array, w: jax.Array,
+                             lid: jax.Array, chunk_slot: jax.Array,
+                             chunk_block: jax.Array, chunk_leaf: jax.Array,
+                             *, num_bins: int, n_slots: int,
+                             word_tile: int = 2, row_block: int = 2048,
+                             nterms: int = 2, interpret: bool = False
+                             ) -> jax.Array:
+    """Per-slot histograms over lid-masked row chunks (see block comment).
+
+    bins_words : (Fw, N) int32 packed codes; w (3, N) f32; lid (N,) int32.
+    chunk_*    : (T,) int32 — output slot (== n_slots ⇒ no-op), row-block
+                 index, and lid value per chunk; slots non-decreasing.
+    Returns (n_slots, Fw*4, num_bins, 3) f32.
+    """
+    fw, n = bins_words.shape
+    if fw % word_tile or (word_tile % 8 and word_tile != fw):
+        word_tile = 8 if fw % 8 == 0 else fw
+    rb = min(row_block, n)
+    while n % rb:
+        rb //= 2
+    assert rb >= 128, (n, row_block)
+    b_pad = _round_up(num_bins, 128)
+    grid = (fw // word_tile, chunk_slot.shape[0])
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((word_tile, rb),
+                         lambda i, t, s, b, l: (i, b[t])),
+            pl.BlockSpec((3, rb), lambda i, t, s, b, l: (0, b[t])),
+            pl.BlockSpec((rb,), lambda i, t, s, b, l: (b[t],)),
+        ],
+        out_specs=pl.BlockSpec((1, word_tile * 4, 3, b_pad),
+                               lambda i, t, s, b, l: (s[t], i, 0, 0)),
+    )
+    out = pl.pallas_call(
+        functools.partial(_hist_kernel_segment, num_bins_padded=b_pad,
+                          word_tile=word_tile, nterms=nterms,
+                          n_slots=n_slots),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((n_slots + 1, fw * 4, 3, b_pad),
+                                       jnp.float32),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(chunk_slot, chunk_block, chunk_leaf, bins_words, w, lid)
+    return out[:n_slots, :, :, :num_bins].transpose(0, 1, 3, 2)
+
+
 def pack_bin_words(bins: jax.Array) -> jax.Array:
     """(F, N) uint8 bin codes → (F/4, N) int32, feature 4k+s in byte s of
     word k.  F must already be padded to a multiple of 4; codes above 255

@@ -106,7 +106,7 @@ def _split_gains(lg, lh, rg, rh, l1, l2, mds, min_c=None, max_c=None,
     jax.jit,
     static_argnames=("lambda_l1", "lambda_l2", "max_delta_step",
                      "min_data_in_leaf", "min_sum_hessian_in_leaf",
-                     "min_gain_to_split"))
+                     "min_gain_to_split", "skip_missing_scan"))
 def find_best_splits(hist: jax.Array, sum_gradients: jax.Array,
                      sum_hessians: jax.Array, num_data: jax.Array,
                      num_bin: jax.Array, missing_type: jax.Array,
@@ -115,7 +115,8 @@ def find_best_splits(hist: jax.Array, sum_gradients: jax.Array,
                      *, lambda_l1: float = 0.0, lambda_l2: float = 0.0,
                      max_delta_step: float = 0.0, min_data_in_leaf: int = 20,
                      min_sum_hessian_in_leaf: float = 1e-3,
-                     min_gain_to_split: float = 0.0) -> SplitCandidates:
+                     min_gain_to_split: float = 0.0,
+                     skip_missing_scan: bool = False) -> SplitCandidates:
     """Best numerical split per feature for one leaf.
 
     hist          : (F, B, 3) f32 — (sum_grad, sum_hess, cnt) per bin
@@ -179,6 +180,27 @@ def find_best_splits(hist: jax.Array, sum_gradients: jax.Array,
     # tie-break: largest threshold wins (right-to-left scan with strict >)
     best_t_m1 = (b - 1) - jnp.argmax(g_m1[:, ::-1], axis=1)
     best_g_m1 = jnp.max(g_m1, axis=1)
+
+    if skip_missing_scan:
+        # caller guarantees every feature is MISSING_NONE (single-scan):
+        # the missing-right scan can contribute nothing
+        take = lambda a, t: jnp.take_along_axis(a, t[:, None], axis=1)[:, 0]
+        best_t = best_t_m1.astype(jnp.int32)
+        lg_b = take(lg_m1, best_t)
+        lh_b = take(lh_m1, best_t)
+        lc_b = take(lc_m1, best_t)
+        lo_b = take(lo_m1, best_t)
+        ro_b = take(ro_m1, best_t)
+        invalid = jnp.isneginf(best_g_m1) | ~feature_mask
+        return SplitCandidates(
+            gain=jnp.where(invalid, K_MIN_SCORE, best_g_m1 - min_gain_shift),
+            threshold=best_t,
+            default_left=jnp.ones(f, bool),
+            left_sum_g=lg_b, left_sum_h=lh_b - K_EPSILON, left_cnt=lc_b,
+            right_sum_g=total_g - lg_b,
+            right_sum_h=total_h - lh_b - K_EPSILON,
+            right_cnt=total_n - lc_b,
+            left_output=lo_b, right_output=ro_b)
 
     # ---- missing-right scan (reference dir == +1), two-scan features only --
     excl_p1 = (is_zero & (bins_i == d_bin)) | \

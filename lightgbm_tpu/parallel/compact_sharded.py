@@ -326,7 +326,7 @@ class ShardedCompactLearner(CompactTPUTreeLearner):
             return self._split_step_compact(st, fmask_pad, i)
 
         state = jax.lax.fori_loop(0, L - 1, body, state)
-        leaf_id = jnp.zeros(n, jnp.int32).at[state.rid_p].set(state.lid_p)
+        leaf_id = lax.sort([state.rid_p, state.lid_p], num_keys=1)[1]
         leaf_output = state.leaf_f[:, LF_OUT].astype(jnp.float32)
         return (state.rec_f, state.rec_i, state.rec_cat, leaf_id,
                 leaf_output)

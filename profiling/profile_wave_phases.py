@@ -61,6 +61,10 @@ def main():
     variant = sys.argv[1] if len(sys.argv) > 1 else "full"
     rows = int(sys.argv[2]) if len(sys.argv) > 2 else 1_000_000
 
+    label = variant
+    if variant.startswith("ablate:"):
+        os.environ["LGBMTPU_WAVE_ABLATE"] = variant.split(":", 1)[1]
+        variant = "full"
     if variant == "noreplay":
         def fake_replay(self, st, feature_mask):
             M = self.M
@@ -75,7 +79,7 @@ def main():
         W = int(variant[1:])
     learner, grad, hess, bag = make(rows, W=W)
     assert isinstance(learner, WaveTPUTreeLearner)
-    print(f"{variant:16s} {timed_tree(learner, grad, hess, bag):8.1f} ms")
+    print(f"{label:28s} {timed_tree(learner, grad, hess, bag):8.1f} ms")
 
 
 if __name__ == "__main__":

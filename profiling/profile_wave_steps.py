@@ -1,0 +1,75 @@
+"""Per-wave timing: drives _wave_body / _replay as standalone jits.
+
+Shows where a wave's time goes as the frontier narrows (sort + masks are
+full-N; hist chunks shrink), plus the replay cost on the fully-grown
+forest.
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from profile_wave_phases import make  # noqa: E402
+
+
+def sync(x):
+    return float(np.asarray(jax.tree_util.tree_leaves(x)[0].ravel()[0]))
+
+
+def main():
+    rows = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
+    learner, grad, hess, bag = make(rows)
+    fmask = jnp.ones(learner.num_features, dtype=bool)
+
+    learner._hist_branches = [learner._make_hist_branch(S)
+                              for S in learner._win_sizes]
+    learner._stall_branches = [
+        learner._make_stall_branch(S, sort_mode=S > learner._sort_cutoff)
+        for S in learner._win_sizes]
+
+    init = jax.jit(lambda b, g, h, bg: learner._init_root_wave(
+        b, g, h, bg, fmask))
+    wave = jax.jit(lambda s: learner._wave_body(s, fmask),
+                   donate_argnums=(0,))
+    replay = jax.jit(lambda s: learner._replay(s, fmask))
+
+    bp = learner.bins_packed()
+    st = init(bp, grad, hess, bag)
+    sync(st.num_nodes)
+    t0 = time.perf_counter()
+    st = init(bp, grad, hess, bag)
+    sync(st.num_nodes)
+    print(f"root init {1e3*(time.perf_counter()-t0):7.1f} ms")
+
+    splits_prev = 0
+    w = 0
+    while True:
+        t0 = time.perf_counter()
+        st = wave(st)
+        ns = int(np.asarray(st.num_splits))
+        dt = 1e3 * (time.perf_counter() - t0)
+        print(f"wave {w:2d}: {dt:7.1f} ms  (+{ns - splits_prev} splits, "
+              f"total {ns})")
+        splits_prev = ns
+        w += 1
+        if ns >= learner.budget or w > 40:
+            break
+
+    out = replay(st)
+    sync(out[3])
+    t0 = time.perf_counter()
+    out = replay(st)
+    sync(out[3])
+    print(f"replay    {1e3*(time.perf_counter()-t0):7.1f} ms  "
+          f"(pops {int(np.asarray(out[3]))})")
+
+
+if __name__ == "__main__":
+    main()

@@ -48,7 +48,7 @@ def _models_equal(pa, pb, X, y, rounds=5, exact=True, **dskw):
 def _pair(**over):
     base = {"objective": "binary", "num_leaves": 31, "max_bin": 63,
             "min_data_in_leaf": 20, "verbosity": -1, "metric": "none",
-            "tpu_sort_cutoff": 0}
+            "tpu_sort_cutoff": 0, "tpu_wave_sort_cutoff": 0}
     base.update(over)
     return dict(base, tpu_learner="compact"), dict(base, tpu_learner="wave")
 
@@ -71,7 +71,8 @@ def test_wave_default_cutoff_tolerance():
     # leaf values
     X, y = _make()
     pa, pb = _pair()
-    del pa["tpu_sort_cutoff"], pb["tpu_sort_cutoff"]
+    for p in (pa, pb):
+        del p["tpu_sort_cutoff"], p["tpu_wave_sort_cutoff"]
     _models_equal(pa, pb, X, y, exact=False)
 
 
@@ -176,6 +177,52 @@ def test_wave_width_invariance():
     a = _train(p1, X, y)
     b = _train(p2, X, y)
     assert a.model_to_string() == b.model_to_string()
+
+
+def test_segment_hist_kernel_interpret():
+    # the wave learner's one-call-per-wave histogram kernel vs a bincount
+    # oracle, in Pallas interpret mode (runs on CPU)
+    import jax.numpy as jnp
+    from lightgbm_tpu.ops.hist_pallas import (build_histogram_segments,
+                                              pack_bin_words)
+
+    rng = np.random.RandomState(31)
+    n, f, b = 4096, 8, 64
+    bins = rng.randint(0, b, (f, n)).astype(np.uint8)
+    w = rng.randn(3, n).astype(np.float32)
+    lid = np.zeros(n, np.int32)
+    # three disjoint windows with distinct lids, misaligned starts
+    wins = [(100, 700, 5), (1000, 900, 9), (2500, 1500, 11)]
+    for s, c, leaf in wins:
+        lid[s:s + c] = leaf
+    rb = 512
+    slot_t, block_t, leaf_t = [], [], []
+    for k, (s, c, leaf) in enumerate(wins):
+        for blk in range(s // rb, (s + c - 1) // rb + 1):
+            slot_t.append(k)
+            block_t.append(blk)
+            leaf_t.append(leaf)
+    T = n // rb + 4
+    while len(slot_t) < T:
+        slot_t.append(3)
+        block_t.append(0)
+        leaf_t.append(-1)
+    out = build_histogram_segments(
+        pack_bin_words(jnp.asarray(bins)), jnp.asarray(w),
+        jnp.asarray(lid), jnp.asarray(slot_t, dtype=jnp.int32),
+        jnp.asarray(block_t, dtype=jnp.int32),
+        jnp.asarray(leaf_t, dtype=jnp.int32),
+        num_bins=b, n_slots=3, row_block=rb, nterms=0, interpret=True)
+    out = np.asarray(out)
+    assert out.shape == (3, f, b, 3)
+    for k, (s, c, leaf) in enumerate(wins):
+        m = (lid == leaf).astype(np.float64)
+        for fi in range(f):
+            for ch in range(3):
+                ref = np.bincount(bins[fi], weights=w[ch] * m,
+                                  minlength=b)[:b]
+                np.testing.assert_allclose(out[k, fi, :, ch], ref,
+                                           rtol=1e-5, atol=1e-4)
 
 
 def test_wave_exact_counts():
