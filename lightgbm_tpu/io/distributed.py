@@ -47,7 +47,6 @@ class LoopbackCluster:
         self.num_machines = num_machines
         self._barrier = threading.Barrier(num_machines)
         self._slots: List = [None] * num_machines
-        self._lock = threading.Lock()
 
     def run(self, fn: Callable, per_rank_args: Sequence) -> List:
         """Run ``fn(net, *per_rank_args[rank])`` on every rank; returns the
@@ -72,6 +71,13 @@ class LoopbackCluster:
             t.start()
         for t in threads:
             t.join()
+        # a rank failure aborts the barrier, so OTHER ranks die with a
+        # secondary BrokenBarrierError — surface the root cause instead
+        root = [e for e in errors
+                if e is not None
+                and not isinstance(e, threading.BrokenBarrierError)]
+        if root:
+            raise root[0]
         for e in errors:
             if e is not None:
                 raise e
@@ -115,24 +121,53 @@ def load_partitioned_file(path: str, params: Dict, rank: int,
                           num_machines: int, pre_partition: bool = False):
     """Read a text data file keeping only this rank's rows (mod-partition
     unless ``pre_partition``); lines owned by other ranks are never parsed,
-    so peak memory is the shard, not the file."""
+    so peak memory is the shard, not the file.
+
+    Returns (matrix, label, weight, group, global_rows) — ``global_rows``
+    maps local row k to its global data-row index (for
+    ``distributed_construct``'s sample alignment).  Sidecar ``.weight`` /
+    ``.query`` files are read from the ORIGINAL path; weights are subset to
+    the owned rows, query files require ``pre_partition`` (a mod-partition
+    would tear query groups apart, `src/io/metadata.cpp` CheckOrPartition).
+    """
     from .parser import load_data_file
 
     if pre_partition or num_machines == 1:
-        return load_data_file(path, params)
+        mat, label, weight, group = load_data_file(path, params)
+        return mat, label, weight, group, np.arange(len(mat), dtype=np.int64)
+
+    params = dict(params or {})
+    has_header = str(params.get("header", params.get("has_header", "false"))
+                     ).lower() in ("true", "1")
     with open(path, "r") as fh:
-        lines = [ln for i, ln in enumerate(fh) if i % num_machines == rank
-                 and ln.strip()]
+        lines = [ln for ln in fh if ln.strip()]
+    header = lines[0] if has_header else None
+    data_lines = lines[1:] if has_header else lines
+    owned = partition_rows(len(data_lines), rank, num_machines,
+                           pre_partition=False)
+    shard_lines = ([header] if header is not None else []) + \
+        [data_lines[i] for i in owned]
+
     import io as _io
     import os
     import tempfile
-    fd, tmp = tempfile.mkstemp(suffix=os.path.splitext(path)[1])
+    fd, tmp = tempfile.mkstemp(suffix=os.path.splitext(path)[1] or ".csv")
     try:
         with _io.open(fd, "w") as out:
-            out.writelines(lines)
-        return load_data_file(tmp, params)
+            out.writelines(shard_lines)
+        mat, label, weight, group = load_data_file(tmp, params)
     finally:
         os.unlink(tmp)
+    # sidecars live next to the ORIGINAL file, not the temp shard
+    from .parser import _load_sidecar
+    full_weight = _load_sidecar(path + ".weight")
+    weight = full_weight[owned] if full_weight is not None else None
+    full_group = _load_sidecar(path + ".query")
+    if full_group is not None:
+        raise ValueError(
+            "query/group files require pre_partition=true: a mod row "
+            "partition would tear query groups across machines")
+    return mat, label, weight, None, owned
 
 
 def _feature_ranges(num_features: int, num_machines: int):
@@ -153,20 +188,32 @@ def distributed_construct(net, shard: np.ndarray, cfg: Config,
                           categorical: Sequence[int] = (),
                           feature_names: Optional[List[str]] = None,
                           label: Optional[np.ndarray] = None,
+                          global_rows: Optional[np.ndarray] = None,
                           ) -> _ConstructedDataset:
     """Construct this rank's row shard of a dataset with globally-identical
     bin mappers (see module docstring).  ``shard`` is the LOCAL row block
-    ``(n_local, F)``; returns a `_ConstructedDataset` over just those rows,
-    with ``row_offset``/``num_data_global`` recording the global placement
-    (shard r owns global rows [offset, offset + n_local))."""
+    ``(n_local, F)``; ``global_rows`` maps local row k to its global row
+    index (default: ranks own contiguous blocks in rank order — pass the
+    indices from ``load_partitioned_file`` for mod-partitioned shards).
+    Returns a `_ConstructedDataset` over just those rows with
+    ``global_rows``/``num_data_global`` recording the placement."""
     shard = np.ascontiguousarray(shard, dtype=np.float64)
     n_local, f_local = shard.shape
 
-    # ---- global shape agreement
-    f = net.sync_min(f_local)
+    # ---- global shape agreement (fail fast on column disagreement)
+    fs = net.allgather(int(f_local))
+    if len(set(fs)) != 1:
+        raise ValueError(f"ranks disagree on feature count: {fs}")
+    f = fs[0]
     counts = net.allgather(int(n_local))
     n_total = int(sum(counts))
     offset = int(sum(counts[:net.rank]))
+    if global_rows is None:
+        global_rows = np.arange(offset, offset + n_local, dtype=np.int64)
+    else:
+        global_rows = np.asarray(global_rows, dtype=np.int64).reshape(-1)
+        if len(global_rows) != n_local:
+            raise ValueError("global_rows length != shard rows")
 
     # ---- one GLOBAL sample sequence; each rank contributes its rows
     if n_total > cfg.bin_construct_sample_cnt:
@@ -175,13 +222,20 @@ def distributed_construct(net, shard: np.ndarray, cfg: Config,
                                         replace=False))
     else:
         sample_idx = np.arange(n_total)
-    mine = (sample_idx >= offset) & (sample_idx < offset + n_local)
-    local_sample = shard[sample_idx[mine] - offset]
-    parts = net.allgather(local_sample)
-    # ranks own contiguous global row ranges, so rank-order concat of the
-    # (sorted) per-rank picks reproduces the global sorted sample order
-    sample = np.concatenate([p for p in parts if len(p)], axis=0) \
-        if any(len(p) for p in parts) else np.zeros((0, f))
+    order = np.argsort(global_rows, kind="stable")
+    sorted_rows = global_rows[order]
+    pos = np.searchsorted(sorted_rows, sample_idx)
+    hit = (pos < n_local)
+    hit[hit] = sorted_rows[pos[hit]] == sample_idx[hit]
+    local_pick = order[pos[hit]]
+    local_sample = shard[local_pick]
+    parts = net.allgather((local_sample, sample_idx[hit]))
+    gidx = np.concatenate([p[1] for p in parts]) if parts else np.zeros(0)
+    stacked = np.concatenate([p[0] for p in parts if len(p[0])], axis=0) \
+        if any(len(p[0]) for p in parts) else np.zeros((0, f))
+    # re-sort to global row order so the sample matrix is byte-identical to
+    # the single-host `mat[sample_idx]` regardless of the shard layout
+    sample = stacked[np.argsort(gidx, kind="stable")]
     total_sample_cnt = len(sample)
 
     # ---- each rank finds bins for its feature range over the full sample
@@ -228,6 +282,7 @@ def distributed_construct(net, shard: np.ndarray, cfg: Config,
     # derived from local rows and would disagree across ranks (the parallel
     # learners consume unbundled columns anyway)
     ds._bin_all(shard, cfg, is_reference_linked=True)
-    ds.row_offset = offset
+    ds.global_rows = global_rows
+    ds.row_offset = offset          # contiguous-layout convenience
     ds.num_data_global = n_total
     return ds

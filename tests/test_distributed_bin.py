@@ -124,12 +124,63 @@ def test_load_partitioned_file(tmp_path):
     params = {"header": False, "label_column": 0}
     mats = []
     for r in range(3):
-        mat, label, _, _ = load_partitioned_file(str(p), params, r, 3)
+        mat, label, _, _, gr = load_partitioned_file(str(p), params, r, 3)
         mats.append((mat, label))
-        assert len(mat) == len(partition_rows(20, r, 3, False))
+        np.testing.assert_array_equal(gr, partition_rows(20, r, 3, False))
     # every global row appears on exactly one rank
     from lightgbm_tpu.io.parser import load_data_file
     full, full_label, _, _ = load_data_file(str(p), params)
     got = np.concatenate([m for m, _ in mats])
     assert sorted(map(tuple, got.tolist())) == \
         sorted(map(tuple, full.tolist()))
+
+
+def test_load_partitioned_header_and_weights(tmp_path):
+    """Mod-partition with a header line: no rank loses a data row, and the
+    .weight sidecar is read from the original path and row-subset."""
+    rows = ["%d,%.3f,%.3f" % (i % 3, i * 0.5, i) for i in range(13)]
+    p = tmp_path / "hdr.csv"
+    p.write_text("label,f0,f1\n" + "\n".join(rows) + "\n")
+    (tmp_path / "hdr.csv.weight").write_text(
+        "\n".join(str(0.1 * (i + 1)) for i in range(13)) + "\n")
+    params = {"header": True, "label_column": 0}
+    seen = []
+    for r in range(2):
+        mat, label, weight, group, gr = load_partitioned_file(
+            str(p), params, r, 2)
+        owned = partition_rows(13, r, 2, False)
+        np.testing.assert_array_equal(gr, owned)
+        assert len(mat) == len(owned)
+        np.testing.assert_allclose(mat[:, 0], owned * 0.5)
+        np.testing.assert_allclose(weight, 0.1 * (owned + 1))
+        seen.extend(gr.tolist())
+    assert sorted(seen) == list(range(13))
+
+
+def test_mod_partition_mappers_match_single_host():
+    """Interleaved (mod-partitioned) shards with explicit global_rows still
+    produce mappers bit-identical to single-host binning."""
+    X = _make_matrix(n=4000, f=7)
+    cfg = Config.from_params({"max_bin": 31,
+                              "bin_construct_sample_cnt": 1500})
+    ref = _ConstructedDataset.from_matrix(X, cfg)
+    k = 3
+    cluster = LoopbackCluster(k)
+    args = []
+    for r in range(k):
+        rows = partition_rows(len(X), r, k, pre_partition=False)
+        args.append((X[rows], rows))
+    outs = cluster.run(
+        lambda net, shard, rows: distributed_construct(
+            net, shard, cfg, global_rows=rows),
+        args)
+    for ds in outs:
+        assert len(ds.bin_mappers) == len(ref.bin_mappers)
+        for a, b in zip(ds.bin_mappers, ref.bin_mappers):
+            assert _mapper_equal(a, b)
+    # shard bins equal the single-host bins at the owned rows
+    for r, ds in enumerate(outs):
+        rows = partition_rows(len(X), r, k, pre_partition=False)
+        np.testing.assert_array_equal(
+            ds.bins[:len(ds.bin_mappers), :len(rows)],
+            ref.bins[:len(ref.bin_mappers), rows])
