@@ -30,6 +30,7 @@ from ..dataset import Dataset, _ConstructedDataset
 from ..learner import TPUTreeLearner
 from ..metrics import Metric, create_metric
 from ..objectives import ObjectiveFunction, create_objective
+from ..ops.histogram import _on_tpu
 from ..ops.lookup import lookup_f32 as _lookup_small
 from ..tree import Tree
 
@@ -66,11 +67,17 @@ class ScoreUpdater:
         """Train-side update: gather the (host-renewed, shrunk) leaf values by
         the learner's final leaf partition (`score_updater.hpp:74-96`).
 
-        The per-row lookup is a one-hot matmul, not an XLA gather — on TPU a
-        1M-row gather from a small table costs ~8 ms while the MXU one-hot
-        contraction is ~0.5 ms (profiling/profile_gather_alts.py)."""
+        On TPU the per-row lookup is a one-hot matmul, not an XLA gather — a
+        1M-row gather from a small table costs ~8 ms there while the MXU
+        one-hot contraction is ~0.5 ms (profiling/profile_gather_alts.py);
+        on CPU/GPU backends a plain gather is cheaper and the results are
+        bit-identical either way (lookup_f32 is exact)."""
         lv = jnp.asarray(leaf_values.astype(np.float32))
-        self.score = self.score.at[class_id].add(_lookup_small(lv, leaf_id))
+        if _on_tpu():
+            upd = _lookup_small(lv, leaf_id)
+        else:
+            upd = lv[leaf_id]
+        self.score = self.score.at[class_id].add(upd)
 
     def add_by_tree(self, tree: Tree, class_id: int) -> None:
         """Valid-side update: traverse the tree over this dataset's binned
@@ -363,6 +370,18 @@ class GBDT:
             objective.init(data.metadata, data.num_data, data.num_data_padded)
         from ..learner_compact import create_tree_learner
         self.learner = create_tree_learner(self.cfg, data)
+        if self.cfg.forcedsplits_filename and \
+                hasattr(self.learner, "set_forced_splits"):
+            from ..forced import load_forced_splits
+            forced = load_forced_splits(self.cfg.forcedsplits_filename, data)
+            if forced and len(forced) > self.cfg.num_leaves - 1:
+                import warnings
+                warnings.warn(
+                    f"forced-splits tree has {len(forced)} splits but "
+                    f"num_leaves={self.cfg.num_leaves} allows "
+                    f"{self.cfg.num_leaves - 1}; truncating in BFS order")
+                forced = forced[:self.cfg.num_leaves - 1]
+            self.learner.set_forced_splits(forced)
         self.train_score = ScoreUpdater(data, self.num_tree_per_iteration)
         self.training_metrics = list(training_metrics)
         self.max_feature_idx = data.num_total_features - 1

@@ -329,10 +329,14 @@ class Config:
     # (partition/histogram/scan amortized across the wave; an exact greedy
     # replay trims the speculative forest back to best-first semantics)
     tpu_wave_width: int = 64
-    # byte budget for the wave learner's histogram pool + per-wave child
-    # histograms; configs that exceed it fall back to the sequential
-    # compact learner
-    tpu_wave_max_bytes: int = 1 << 31
+    # byte budget for the wave learner's working set (histogram pool,
+    # per-wave child histograms, wave-mask transients, sort buffers);
+    # configs that exceed it fall back to the sequential compact learner
+    tpu_wave_max_bytes: int = 1 << 32
+    # speculative growth overshoot as a fraction of (num_leaves - 1):
+    # extra bottom waves pre-split the leaves the exact greedy replay will
+    # want, trading cheap frozen-window waves for expensive replay stalls
+    tpu_wave_overshoot: float = 0.25
     # wave members whose window is at or below this size split in place
     # (lid-lane rewrite, children share the parent span) instead of joining
     # the global re-compaction sort; a wave with no sortable member skips
@@ -403,12 +407,6 @@ class Config:
         if self.bagging_fraction < 1.0 and self.bagging_freq == 0:
             # bagging only active when bagging_freq > 0 (`gbdt.cpp:689` semantics)
             pass
-        # loudly reject parameters that parse but are not implemented yet —
-        # silently training a different model than the reference is worse
-        # than failing
-        if self.forcedsplits_filename:
-            warnings.warn("forcedsplits_filename is not implemented in "
-                          "lightgbm_tpu yet; the parameter is IGNORED")
 
     def to_dict(self) -> Dict[str, Any]:
         d = dataclasses.asdict(self)

@@ -139,14 +139,23 @@ def load_partitioned_file(path: str, params: Dict, rank: int,
     params = dict(params or {})
     has_header = str(params.get("header", params.get("has_header", "false"))
                      ).lower() in ("true", "1")
+    # stream: only OWNED lines are kept, so peak memory is the shard
+    header = None
+    shard_lines = []
+    n_data = 0
     with open(path, "r") as fh:
-        lines = [ln for ln in fh if ln.strip()]
-    header = lines[0] if has_header else None
-    data_lines = lines[1:] if has_header else lines
-    owned = partition_rows(len(data_lines), rank, num_machines,
-                           pre_partition=False)
-    shard_lines = ([header] if header is not None else []) + \
-        [data_lines[i] for i in owned]
+        for ln in fh:
+            if not ln.strip():
+                continue
+            if has_header and header is None:
+                header = ln
+                continue
+            if n_data % num_machines == rank:
+                shard_lines.append(ln)
+            n_data += 1
+    owned = partition_rows(n_data, rank, num_machines, pre_partition=False)
+    if header is not None:
+        shard_lines = [header] + shard_lines
 
     import io as _io
     import os

@@ -203,12 +203,31 @@ class TPUTreeLearner:
                             backend=self.hist_backend, dp=self.hist_dp)
         return h[:self.num_features]  # drop feature-tile padding rows
 
+    def _fix_histogram(self, hist, sum_g, sum_h, cnt):
+        """``Dataset::FixHistogram`` (`src/io/dataset.cpp:923-941`): every
+        feature with ``default_bin > 0`` gets its default-bin entry REBUILT
+        as leaf totals minus the other bins before any scan — the
+        reference's histogram construction skips default-bin rows, so this
+        is load-bearing there; here it is an exact no-op on consistent
+        paths but reproduces the reference's behavior on forced-split
+        chains, whose GatherInfo sums disagree with the actual partition
+        (the delta lands in the default bin exactly like the reference)."""
+        dt = hist.dtype
+        db = self.f_default_bin
+        dbm = (jnp.arange(hist.shape[1])[None, :] == db[:, None]) & \
+            (db[:, None] > 0)                                    # (F, B)
+        totals = jnp.stack([sum_g, sum_h, cnt]).astype(dt)       # (3,)
+        others = jnp.sum(jnp.where(dbm[..., None], 0.0, hist), axis=1)
+        fixed = totals[None, :] - others                         # (F, 3)
+        return jnp.where(dbm[..., None], fixed[:, None, :], hist)
+
     def _feature_cands(self, hist, sum_g, sum_h, cnt, feature_mask,
                        min_c=None, max_c=None) -> _FeatCand:
         """Merged per-feature candidates: each feature scanned by its own
         finder (`FeatureHistogram::FuncForNumrical/FuncForCategorical`,
         `feature_histogram.hpp:256-270`).  min_c/max_c are this leaf's
         monotone value constraints."""
+        hist = self._fix_histogram(hist, sum_g, sum_h, cnt)
         f = self.num_features
         w = self.cat_W
         if not self.has_monotone:
@@ -321,15 +340,20 @@ class TPUTreeLearner:
             leaf_max_c=jnp.full(L, jnp.inf, jnp.float32))
 
     def _split_step(self, state: TreeState, grad, hess, bag, feature_mask,
-                    step_idx) -> TreeState:
+                    step_idx, forced=None) -> TreeState:
+        """One split; ``forced=(leaf, info, do)`` replaces best-gain
+        selection with a forced split (`serial_tree_learner.cpp:543-663`)."""
         cfg = self.cfg
         cand = state.cand
-        best_leaf = jnp.argmax(cand.gain).astype(jnp.int32)
-        best_gain = cand.gain[best_leaf]
-        do = best_gain > 0.0
+        if forced is None:
+            best_leaf = jnp.argmax(cand.gain).astype(jnp.int32)
+            info = jax.tree_util.tree_map(lambda a: a[best_leaf], cand)
+            do = info.gain > 0.0
+        else:
+            best_leaf, info, do = forced
+            best_leaf = jnp.asarray(best_leaf, jnp.int32)
+        best_gain = info.gain
         dof = do.astype(jnp.float32)
-
-        info = jax.tree_util.tree_map(lambda a: a[best_leaf], cand)
         new_leaf = state.num_leaves
 
         # ---- partition rows (`data_partition.hpp` Split → `tree.h:233-249`
@@ -374,7 +398,14 @@ class TPUTreeLearner:
         hist_pool = hist_pool.at[new_leaf].set(
             jnp.where(do, hist_right, hist_pool[new_leaf]))
 
-        # ---- leaf bookkeeping
+        # ---- leaf bookkeeping.  Forced splits mirror the reference's
+        # convention: child SUMS from GatherInfoForThreshold, child COUNTS
+        # from the actual partition (`leaf_splits.hpp:40-52` reads
+        # ``leaf_count`` off the data partition) — see learner_compact.py.
+        if forced is not None:
+            info = info._replace(left_cnt=lc_bag.astype(info.left_cnt.dtype),
+                                 right_cnt=(c_bag - lc_bag)
+                                 .astype(info.right_cnt.dtype))
         upd = lambda arr, l_val, r_val: (
             arr.at[best_leaf].set(jnp.where(do, l_val, arr[best_leaf]))
                .at[new_leaf].set(jnp.where(do, r_val, arr[new_leaf])))
@@ -447,17 +478,76 @@ class TPUTreeLearner:
             records=records, rec_cat=rec_cat, rec_i=rec_i,
             leaf_min_c=leaf_min_c, leaf_max_c=leaf_max_c)
 
+    def set_forced_splits(self, forced) -> None:
+        """Install the static BFS forced-split list (``forced.py``); must
+        be called before the first train (re-wraps the jitted program)."""
+        self._forced = list(forced) if forced else None
+        self._jit_tree = jax.jit(self._train_tree_fused)
+
+    def _forced_info(self, state: TreeState, fs) -> tuple:
+        """_LeafCand row for one forced split (GatherInfoForThreshold)."""
+        from .ops.split import K_EPSILON, forced_split_info
+        cfg = self.cfg
+        leaf = fs.leaf
+        sum_g = state.leaf_sum_g[leaf]
+        sum_h = state.leaf_sum_h[leaf]
+        cnt = state.leaf_cnt[leaf]
+        # FixHistogram before the gather, like the scans (see
+        # learner_compact.py _forced_candidate_compact)
+        hist = self._fix_histogram(state.hist_pool[leaf], sum_g, sum_h, cnt)
+        hrow = hist[fs.feature_inner]                       # (B, 3)
+        gain, lg, lh, lc, rg, rh, rc, lo, ro, valid = forced_split_info(
+            hrow, sum_g, sum_h, cnt,
+            threshold=fs.threshold_bin,
+            num_bin=int(self.np_num_bin[fs.feature_inner]),
+            missing_type=int(self.np_missing[fs.feature_inner]),
+            default_bin=int(self.np_default_bin[fs.feature_inner]),
+            is_cat=fs.is_cat,
+            lambda_l1=float(cfg.lambda_l1), lambda_l2=float(cfg.lambda_l2),
+            max_delta_step=float(cfg.max_delta_step),
+            min_gain_to_split=float(cfg.min_gain_to_split))
+        cb = np.zeros(self.cat_W, np.uint32)
+        if fs.is_cat:
+            cb[fs.threshold_bin // 32] |= np.uint32(
+                1 << (fs.threshold_bin % 32))
+        info = _LeafCand(
+            gain=gain, feature=jnp.asarray(fs.feature_inner, jnp.int32),
+            threshold=jnp.asarray(fs.threshold_bin, jnp.int32),
+            default_left=jnp.asarray(not fs.is_cat),
+            is_cat=jnp.asarray(fs.is_cat), cat_bits=jnp.asarray(cb),
+            left_sum_g=lg, left_sum_h=lh - K_EPSILON, left_cnt=lc,
+            right_sum_g=rg, right_sum_h=rh - K_EPSILON, right_cnt=rc,
+            left_output=lo, right_output=ro)
+        return info, valid
+
     def _train_tree_fused(self, grad, hess, bag, feature_mask) -> TreeState:
         """The whole leaf-wise growth loop as ONE XLA computation — the
         fusion the reference can't have (its loop is host control flow,
         `serial_tree_learner.cpp:185-218`); on TPU it removes per-split
-        dispatch latency entirely."""
+        dispatch latency entirely.  Records are written at cursor
+        ``num_leaves - 1`` so an aborted forced phase leaves no gap."""
         state = self._init_root(grad, hess, bag, feature_mask)
+        forced = getattr(self, "_forced", None)
+        if forced:
+            aborted = jnp.asarray(False)
+            for fs in forced:
+                info, valid = self._forced_info(state, fs)
+                do = valid & ~aborted
+                state = self._split_step(state, grad, hess, bag,
+                                         feature_mask,
+                                         state.num_leaves - 1,
+                                         forced=(fs.leaf, info, do))
+                aborted = aborted | ~valid
 
-        def body(i, st):
-            return self._split_step(st, grad, hess, bag, feature_mask, i)
+        def cond(st):
+            return (st.num_leaves < self.num_leaves) & \
+                (jnp.max(st.cand.gain) > 0.0)
 
-        return jax.lax.fori_loop(0, self.num_leaves - 1, body, state)
+        return jax.lax.while_loop(
+            cond,
+            lambda st: self._split_step(st, grad, hess, bag, feature_mask,
+                                        st.num_leaves - 1),
+            state)
 
     # -- host orchestration --------------------------------------------------
 

@@ -167,6 +167,16 @@ class CompactTPUTreeLearner(TPUTreeLearner):
         """Bagged split counts; the sharded learner psums local counts."""
         return lc_bag, c_bag
 
+    def _sync_counts3(self, cnt3):
+        """Wave-learner (3, W) member counts [left rows, left bagged,
+        total bagged]; the sharded learner psums the BAGGED rows only
+        (row 0 is local window geometry)."""
+        return cnt3
+
+    def _global_scalar(self, v):
+        """Scalar reduction seam; the sharded learner psums."""
+        return v
+
     def _reduce_hist(self, local_hist):
         """Histogram exchange seam; the sharded learner reduce-scatters."""
         return local_hist
@@ -424,20 +434,28 @@ class CompactTPUTreeLearner(TPUTreeLearner):
     # -- one split -----------------------------------------------------------
 
     def _split_step_compact(self, state: CompactState, feature_mask,
-                            step_idx) -> CompactState:
+                            step_idx, forced=None) -> CompactState:
+        """One split.  ``forced=(leaf, crow_f, crow_i, crow_b, do)``
+        replaces best-gain selection with a forced split
+        (`serial_tree_learner.cpp:543-663`); everything downstream —
+        partition, smaller-child histogram, children bookkeeping, record
+        emission — is shared."""
         cfg = self.cfg
-        best_leaf = jnp.argmax(state.cand_f[:, CF_GAIN]).astype(jnp.int32)
+        if forced is None:
+            best_leaf = jnp.argmax(state.cand_f[:, CF_GAIN]) \
+                .astype(jnp.int32)
+            crow_f = state.cand_f[best_leaf]      # (NUM_CF,) acc
+            crow_i = state.cand_i[best_leaf]      # (NUM_CI,) int32
+            crow_b = state.cand_b[best_leaf]      # (W,) uint32
+            do = crow_f[CF_GAIN] > 0.0
+        else:
+            best_leaf, crow_f, crow_i, crow_b, do = forced
+            best_leaf = jnp.asarray(best_leaf, jnp.int32)
         new_leaf = state.num_leaves
         idx2 = jnp.stack([best_leaf, new_leaf])
-
-        crow_f = state.cand_f[best_leaf]          # (NUM_CF,) acc
-        crow_i = state.cand_i[best_leaf]          # (NUM_CI,) int32
-        crow_b = state.cand_b[best_leaf]          # (W,) uint32
         lrow_i = state.leaf_i[best_leaf]
         lrow_f = state.leaf_f[best_leaf]
-
         best_gain = crow_f[CF_GAIN]
-        do = best_gain > 0.0
         feat = crow_i[CI_FEAT]
         thr = crow_i[CI_THR]
         dleft = (crow_i[CI_FLAGS] & 1) == 1
@@ -481,8 +499,19 @@ class CompactTPUTreeLearner(TPUTreeLearner):
 
         hist_pool = upd2(state.hist_pool, hist_left, hist_right)
 
-        # ---- children bookkeeping rows
+        # ---- children bookkeeping rows.  Forced splits mirror the
+        # reference's inconsistency verbatim: child SUMS come from
+        # GatherInfoForThreshold (right = bins >= thr) while child COUNTS
+        # come from the actual partition (left = bins <= thr) — the
+        # reference's ``LeafSplits::Init(leaf, data_partition_, sum_g,
+        # sum_h)`` reads ``leaf_count`` from the partition
+        # (`leaf_splits.hpp:40-52`), so its next scans run with partition
+        # counts against GatherInfo sums.
         child_depth = lrow_f[LF_DEPTH] + 1.0
+        if forced is not None:
+            crow_f = crow_f.at[CF_LCNT].set(lc_bag.astype(self._acc)) \
+                           .at[CF_RCNT].set((c_bag - lc_bag)
+                                            .astype(self._acc))
         lout = crow_f[CF_LOUT]
         rout = crow_f[CF_ROUT]
         pmin = lrow_f[LF_MIN_C]
@@ -542,6 +571,73 @@ class CompactTPUTreeLearner(TPUTreeLearner):
             num_leaves=state.num_leaves + do.astype(jnp.int32),
             rec_f=rec_f, rec_i=rec_i, rec_cat=rec_cat)
 
+    # -- forced splits (`serial_tree_learner.cpp:543-663`) -------------------
+
+    def set_forced_splits(self, forced) -> None:
+        """Install the static BFS forced-split list (``forced.py``); must be
+        called before the first ``train_async`` (it re-wraps the jitted
+        tree program)."""
+        self._forced = list(forced) if forced else None
+        self._jit_tree_c = jax.jit(self._train_tree_compact)
+
+    def _forced_candidate_compact(self, state: CompactState, fs):
+        """Candidate rows for one forced split from the target leaf's
+        pooled histogram (GatherInfoForThreshold semantics)."""
+        from .ops.split import K_EPSILON, forced_split_info
+        cfg = self.cfg
+        leaf = fs.leaf
+        lrow = state.leaf_f[leaf]
+        sum_g, sum_h, cnt = lrow[LF_SUM_G], lrow[LF_SUM_H], lrow[LF_CNT]
+        hist = state.hist_pool[leaf]
+        if self._bundle is not None:
+            hist = self._unbundle_hist(hist, sum_g, sum_h, cnt)
+        # the reference FixHistograms before GatherInfoForThreshold
+        # (`serial_tree_learner.cpp:486` runs inside the ForceSplits loop's
+        # FindBestSplits) — forced chains must see the same default-bin
+        # reconstruction the scans do
+        hist = self._fix_histogram(hist, sum_g, sum_h, cnt)
+        hrow = hist[fs.feature_inner]                      # (B, 3), static f
+        gain, lg, lh, lc, rg, rh, rc, lo, ro, valid = forced_split_info(
+            hrow, sum_g, sum_h, cnt,
+            threshold=fs.threshold_bin,
+            num_bin=int(self.np_num_bin[fs.feature_inner]),
+            missing_type=int(self.np_missing[fs.feature_inner]),
+            default_bin=int(self.np_default_bin[fs.feature_inner]),
+            is_cat=fs.is_cat,
+            lambda_l1=float(cfg.lambda_l1), lambda_l2=float(cfg.lambda_l2),
+            max_delta_step=float(cfg.max_delta_step),
+            min_gain_to_split=float(cfg.min_gain_to_split))
+        acc = self._acc
+        crow_f = jnp.stack([gain, lg, lh - K_EPSILON, lc, rg,
+                            rh - K_EPSILON, rc, lo, ro]).astype(acc)
+        flags = 2 if fs.is_cat else 1     # numerical: default_left=True
+        crow_i = jnp.asarray([fs.feature_inner, fs.threshold_bin, flags],
+                             jnp.int32)
+        cb = np.zeros(self.cat_W, np.uint32)
+        if fs.is_cat:
+            cb[fs.threshold_bin // 32] |= np.uint32(
+                1 << (fs.threshold_bin % 32))
+        return crow_f, crow_i, jnp.asarray(cb), valid
+
+    def _forced_phase_compact(self, state: CompactState, feature_mask
+                              ) -> CompactState:
+        """Unrolled BFS of the forced-split tree before best-gain growth;
+        an invalid forced split aborts the remaining queue exactly like the
+        reference's break (`serial_tree_learner.cpp:612-616`)."""
+        forced = getattr(self, "_forced", None)
+        if not forced:
+            return state
+        aborted = jnp.asarray(False)
+        for fs in forced:
+            crow_f, crow_i, crow_b, valid = \
+                self._forced_candidate_compact(state, fs)
+            do = valid & ~aborted
+            state = self._split_step_compact(
+                state, feature_mask, state.num_leaves - 1,
+                forced=(fs.leaf, crow_f, crow_i, crow_b, do))
+            aborted = aborted | ~valid
+        return state
+
     # -- whole tree ----------------------------------------------------------
 
     def _train_tree_compact(self, bins_p, grad, hess, bag, feature_mask):
@@ -554,11 +650,20 @@ class CompactTPUTreeLearner(TPUTreeLearner):
             for S in self._win_sizes]
         state = self._init_root_compact(bins_p, grad, hess, bag,
                                         feature_mask)
+        state = self._forced_phase_compact(state, feature_mask)
 
-        def body(i, st):
-            return self._split_step_compact(st, feature_mask, i)
+        # records are written at cursor ``num_leaves - 1`` (number of
+        # successful splits so far), so an aborted forced phase can't leave
+        # an invalid-record gap that truncates host assembly
+        def cond(st):
+            return (st.num_leaves < self.num_leaves) & \
+                (jnp.max(st.cand_f[:, CF_GAIN]) > 0.0)
 
-        state = jax.lax.fori_loop(0, self.num_leaves - 1, body, state)
+        state = jax.lax.while_loop(
+            cond,
+            lambda st: self._split_step_compact(st, feature_mask,
+                                                st.num_leaves - 1),
+            state)
         # leaf partition in ORIGINAL row order for the score updater
         # descatter to original row order via a 2-lane sort (~3x cheaper
         # than the equivalent scatter on TPU)
@@ -616,15 +721,49 @@ def create_tree_learner(cfg: Config, data: _ConstructedDataset,
     the masked learner's full-row passes).
     """
     mode = cfg.tpu_learner
+    explicit = mode != "auto"
+    verbose = int(getattr(cfg, "verbosity", 1))
     if mode == "auto":
         mode = "wave"
+    reason = None
+    if mode == "wave" and cfg.forcedsplits_filename:
+        # forced splits ride the sequential learners' split-step machinery;
+        # the compact learner builds the identical tree, just without
+        # frontier batching
+        if verbose >= 1:
+            print("[lightgbm_tpu] forcedsplits_filename set: using the "
+                  "sequential compact learner (identical trees)")
+        mode = "compact"
     if mode == "wave":
-        from .learner_wave import WaveTPUTreeLearner, wave_eligible
-        if wave_eligible(cfg, data):
+        from .learner_wave import WaveTPUTreeLearner, wave_ineligible_reason
+        reason = wave_ineligible_reason(cfg, data)
+        if reason is None:
+            if verbose >= 1 and explicit is False:
+                pass  # the default choice needs no announcement
             return WaveTPUTreeLearner(cfg, data, hist_backend)
         mode = "compact"
+        if explicit:
+            import warnings
+            warnings.warn(
+                f"tpu_learner=wave was requested but is ineligible "
+                f"({reason}); falling back to the sequential compact "
+                f"learner")
+        elif verbose >= 1:
+            print(f"[lightgbm_tpu] wave learner ineligible ({reason}); "
+                  f"using the sequential compact learner")
     if mode == "compact":
         if data.max_num_bin > 256 or cfg.tree_learner not in ("serial",):
+            why = (f"max_num_bin={data.max_num_bin} > 256"
+                   if data.max_num_bin > 256
+                   else f"tree_learner={cfg.tree_learner}")
+            if explicit:
+                import warnings
+                warnings.warn(f"tpu_learner=compact was requested but is "
+                              f"ineligible ({why}); falling back to the "
+                              f"masked learner")
+            elif verbose >= 1:
+                print(f"[lightgbm_tpu] compact learner ineligible ({why}); "
+                      f"using the masked learner")
             mode = "masked"
     if mode == "compact":
         return CompactTPUTreeLearner(cfg, data, hist_backend)

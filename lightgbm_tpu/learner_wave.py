@@ -94,12 +94,7 @@ class WaveTPUTreeLearner(CompactTPUTreeLearner):
     def __init__(self, cfg: Config, data: _ConstructedDataset,
                  hist_backend: str = "auto"):
         super().__init__(cfg, data, hist_backend)
-        self.budget = self.num_leaves - 1
-        self.W = max(1, min(int(cfg.tpu_wave_width), self.budget))
-        # growth performs <= budget splits, the exact-replay correction
-        # <= budget more: slot/pool sizing makes overflow impossible
-        self.M = 1 + 4 * self.budget
-        self.H = 2 * self.budget + 2
+        self._init_wave_dims(cfg)
         F = self.num_features
         if self._bundle is not None:
             col = np.asarray(self._bundle.f_gcol, np.int32)
@@ -116,18 +111,51 @@ class WaveTPUTreeLearner(CompactTPUTreeLearner):
         while self.n_pad % rb:
             rb //= 2
         self._seg_rb = rb
+        self._jit_tree_w = jax.jit(self._train_tree_wave)
+
+    def _init_wave_dims(self, cfg: Config) -> None:
+        """Wave sizing/bookkeeping shared by the serial and sharded wave
+        learners (kept in one place so the slot/pool formulas can't drift
+        from ``wave_ineligible_reason``'s byte estimate).
+
+        Growth OVERSHOOTS the split budget: speculative top-W selection
+        near the end of the budget misses leaves the exact greedy replay
+        wants (measured: 40 replay stalls per 255-leaf tree at 1M rows,
+        each a full sequential split step), while extra bottom waves are
+        cheap (small windows freeze — no sort).  The replay still pops
+        exactly ``budget`` splits, so the tree is unchanged.  Slot/pool
+        sizing makes overflow impossible: growth performs <= grow_budget
+        splits, the replay correction <= budget more."""
+        self.budget = self.num_leaves - 1
+        self.W = max(1, min(int(cfg.tpu_wave_width), self.budget))
+        self.grow_budget = min(
+            self.budget + int(np.ceil(self.budget
+                                      * float(cfg.tpu_wave_overshoot))),
+            2 * self.budget)
+        self.M = 1 + 2 * (self.grow_budget + self.budget)
+        self.H = self.grow_budget + self.budget + 2
+        # row-chunk bound for the per-row mask contractions: bounds the
+        # (rows, W) transients to ~256 MB at any N (lax.map'd above it)
+        self._row_chunk = 1 << 20
         # frozen (shared-span) windows can be as large as the wave cutoff,
         # so phase-2 stall splits may only sort above it (a sort-mode
         # partition of a shared window would reorder sibling rows)
         self._wave_cutoff = int(cfg.tpu_wave_sort_cutoff)
         self._stall_cutoff = max(self._sort_cutoff, self._wave_cutoff)
         # dev-only phase ablation for profiling (profile_wave_phases.py):
-        # comma-set of {nohist, noscan, nosort} — NOT a user knob
+        # comma-set of {nohist, noscan, nosort} — NOT a user knob; a leaked
+        # env var would silently train WRONG trees, so warn loudly
         import os
         self._ablate = set(
             t for t in os.environ.get("LGBMTPU_WAVE_ABLATE", "").split(",")
             if t)
-        self._jit_tree_w = jax.jit(self._train_tree_wave)
+        if self._ablate:
+            import warnings
+            warnings.warn(
+                "LGBMTPU_WAVE_ABLATE=%s is set: the wave learner is running "
+                "in a PROFILING-ONLY ablation mode and will produce WRONG "
+                "trees. Unset it for real training." %
+                os.environ["LGBMTPU_WAVE_ABLATE"])
 
     # -- batched split finder -------------------------------------------------
 
@@ -152,15 +180,16 @@ class WaveTPUTreeLearner(CompactTPUTreeLearner):
 
     def _init_root_wave(self, bins_p, grad, hess, bag, feature_mask
                         ) -> WaveState:
-        n, L, M, H = self.n_pad, self.num_leaves, self.M, self.H
+        n, L, M, H = self._rows_len(), self.num_leaves, self.M, self.H
         acc = self._acc
         w = jnp.stack([grad * bag, hess * bag, bag], axis=0)
         lid0 = jnp.zeros(n, jnp.int32)
-        root_hist = self._hist_branches[-1](bins_p, w, lid0, jnp.int32(0),
-                                            jnp.int32(n), jnp.int32(0))
-        sum_g = jnp.sum((grad * bag).astype(acc))
-        sum_h = jnp.sum((hess * bag).astype(acc))
-        cnt = jnp.sum(bag.astype(acc))
+        root_hist = self._reduce_hist(
+            self._hist_branches[-1](bins_p, w, lid0, jnp.int32(0),
+                                    jnp.int32(n), jnp.int32(0)))
+        sum_g = self._global_scalar(jnp.sum((grad * bag).astype(acc)))
+        sum_h = self._global_scalar(jnp.sum((hess * bag).astype(acc)))
+        cnt = self._global_scalar(jnp.sum(bag.astype(acc)))
         md = int(self.cfg.max_depth)
         depth_ok = jnp.asarray([True if md <= 0 else md > 0])
         cf, ci, cb = self._cand_rows_batch(
@@ -282,12 +311,12 @@ class WaveTPUTreeLearner(CompactTPUTreeLearner):
         return st
 
     def _wave_body(self, st: WaveState, feature_mask) -> WaveState:
-        W, M, n = self.W, self.M, self.n_pad
+        W, M, n = self.W, self.M, self._rows_len()
         fw = self.fw
         # ---- select the wave: top-W positive-gain frontier leaves
         g = self._pool_gains(st)
         gv, wi = lax.top_k(g, W)
-        rem = self.budget - st.num_splits
+        rem = self.grow_budget - st.num_splits
         valid = (gv > 0.0) & (jnp.arange(W) < rem)
         pos = jnp.cumsum(valid.astype(jnp.int32)) - valid.astype(jnp.int32)
         lslot = st.num_nodes + 2 * pos
@@ -308,10 +337,6 @@ class WaveTPUTreeLearner(CompactTPUTreeLearner):
         nb = self.f_num_bin[feat]
         boff = self.fw_goff[feat]
         bnd = self.fw_bnd[feat]
-        # ---- per-row params via MXU mask-matmul (gathers are ~5 ms/M rows
-        # on TPU, the one-hot contraction ~0.5 ms)
-        mask = (st.lid_p[:, None] == wi[None, :]) & valid[None, :]  # (N, W)
-        mask_f = mask.astype(jnp.float32)
         # members at or below the wave cutoff split in place (lid rewrite,
         # children share the parent span); only sortable members join the
         # global sort
@@ -324,78 +349,130 @@ class WaveTPUTreeLearner(CompactTPUTreeLearner):
                        rslot.astype(jnp.float32),
                        sortable.astype(jnp.float32)],
                       axis=1)                                       # (W, C)
-        pm = lax.dot_general(mask_f, P, (((1,), (0,)), ((), ())),
-                             precision=_HIGH)                       # (N, C)
-        in_wave = jnp.any(mask, axis=1)
-        ri = lambda c: jnp.rint(pm[:, c]).astype(jnp.int32)
-        widx_r, shift_r, thr_r = ri(0), ri(1), ri(2)
-        dleft_r = pm[:, 3] > 0.5
-        iscat_r = pm[:, 4] > 0.5
-        mt_r, db_r, nb_r = ri(5), ri(6), ri(7)
-        boff_r, bnd_r = ri(8), ri(9)
-        lslot_r, rslot_r = ri(10), ri(11)
-        sortable_r = pm[:, 12] > 0.5
-        # ---- per-row decision (NumericalDecisionInner `tree.h:233-249`)
-        word = jnp.zeros(n, jnp.int32)
-        for wdi in range(fw):
-            word = word + jnp.where(widx_r == wdi, st.bins_p[wdi], 0)
-        code = (word >> shift_r) & 0xFF
-        if self._bundle is not None:
-            r = code - boff_r
-            in_r = (r >= 0) & (r < nb_r - 1)
-            dec = r + (r >= db_r).astype(r.dtype)
-            frow = jnp.where(bnd_r == 1, jnp.where(in_r, dec, db_r), code)
-        else:
-            frow = code
-        is_missing = ((mt_r == MISSING_ZERO) & (frow == db_r)) | \
-                     ((mt_r == MISSING_NAN) & (frow == nb_r - 1))
-        go_left = jnp.where(is_missing, dleft_r, frow <= thr_r)
+        cat16 = None
         if self.has_categorical:
             cb_w = st.cand_b[wi]                                # (W, Wc)
             cat16 = jnp.concatenate(
                 [(cb_w & jnp.uint32(0xFFFF)).astype(jnp.float32),
                  (cb_w >> jnp.uint32(16)).astype(jnp.float32)], axis=1)
-            catpm = lax.dot_general(mask_f, cat16, (((1,), (0,)), ((), ())),
-                                    precision=_HIGH)            # (N, 2*Wc)
-            j = frow >> 5
-            lo = jnp.zeros(n, jnp.float32)
-            hi = jnp.zeros(n, jnp.float32)
-            for jj in range(self.cat_W):
-                sel = j == jj
-                lo = lo + jnp.where(sel, catpm[:, jj], 0.0)
-                hi = hi + jnp.where(sel, catpm[:, self.cat_W + jj], 0.0)
-            catw = (jnp.rint(hi).astype(jnp.int32).astype(jnp.uint32)
-                    << jnp.uint32(16)) | \
-                jnp.rint(lo).astype(jnp.int32).astype(jnp.uint32)
-            cat_left = (catw >> (frow & 31).astype(jnp.uint32)) & 1
-            go_left = jnp.where(iscat_r, cat_left == 1, go_left)
-        go_left = go_left & in_wave
-        # ---- exact integer counts via f32-exact one-hot contractions
-        gl_f = go_left.astype(jnp.float32)
-        bag_f = (st.w_p[2] > 0.5).astype(jnp.float32)
-        cnt3 = lax.dot_general(
-            jnp.stack([gl_f, gl_f * bag_f, bag_f], 0), mask_f,
-            (((1,), (0,)), ((), ())), precision=_HIGH)          # (3, W)
-        lc_w = jnp.rint(cnt3[0]).astype(jnp.int32)
-        lc_bag = jnp.rint(cnt3[1]).astype(jnp.int32)
-        c_bag = jnp.rint(cnt3[2]).astype(jnp.int32)
-        # ---- window-order keys.  INVARIANT: every leaf's rows carry
+
+        # -- pass 1 (per row chunk): wave-member mask -> split params via
+        # MXU mask-matmul (gathers are ~5 ms/M rows on TPU, the one-hot
+        # contraction ~0.5 ms), per-row decision, partial exact counts
+        def decide(bins_c, lid_c, bag_c):
+            ch_n = lid_c.shape[0]
+            mask = (lid_c[:, None] == wi[None, :]) & valid[None, :]
+            mask_f = mask.astype(jnp.float32)
+            pm = lax.dot_general(mask_f, P, (((1,), (0,)), ((), ())),
+                                 precision=_HIGH)               # (ch, C)
+            in_wave = jnp.any(mask, axis=1)
+            ri = lambda c: jnp.rint(pm[:, c]).astype(jnp.int32)
+            widx_r, shift_r, thr_r = ri(0), ri(1), ri(2)
+            dleft_r = pm[:, 3] > 0.5
+            iscat_r = pm[:, 4] > 0.5
+            mt_r, db_r, nb_r = ri(5), ri(6), ri(7)
+            boff_r, bnd_r = ri(8), ri(9)
+            lslot_r, rslot_r = ri(10), ri(11)
+            sortable_r = pm[:, 12] > 0.5
+            # per-row decision (NumericalDecisionInner `tree.h:233-249`)
+            word = jnp.zeros(ch_n, jnp.int32)
+            for wdi in range(fw):
+                word = word + jnp.where(widx_r == wdi, bins_c[wdi], 0)
+            code = (word >> shift_r) & 0xFF
+            if self._bundle is not None:
+                r = code - boff_r
+                in_r = (r >= 0) & (r < nb_r - 1)
+                dec = r + (r >= db_r).astype(r.dtype)
+                frow = jnp.where(bnd_r == 1, jnp.where(in_r, dec, db_r),
+                                 code)
+            else:
+                frow = code
+            is_missing = ((mt_r == MISSING_ZERO) & (frow == db_r)) | \
+                         ((mt_r == MISSING_NAN) & (frow == nb_r - 1))
+            go_left = jnp.where(is_missing, dleft_r, frow <= thr_r)
+            if self.has_categorical:
+                catpm = lax.dot_general(mask_f, cat16,
+                                        (((1,), (0,)), ((), ())),
+                                        precision=_HIGH)        # (ch, 2*Wc)
+                j = frow >> 5
+                lo = jnp.zeros(ch_n, jnp.float32)
+                hi = jnp.zeros(ch_n, jnp.float32)
+                for jj in range(self.cat_W):
+                    sel = j == jj
+                    lo = lo + jnp.where(sel, catpm[:, jj], 0.0)
+                    hi = hi + jnp.where(sel, catpm[:, self.cat_W + jj], 0.0)
+                catw = (jnp.rint(hi).astype(jnp.int32).astype(jnp.uint32)
+                        << jnp.uint32(16)) | \
+                    jnp.rint(lo).astype(jnp.int32).astype(jnp.uint32)
+                cat_left = (catw >> (frow & 31).astype(jnp.uint32)) & 1
+                go_left = jnp.where(iscat_r, cat_left == 1, go_left)
+            go_left = go_left & in_wave
+            # exact integer counts via f32-exact one-hot contractions: the
+            # chunk bound keeps per-chunk counts <= 2^20 (f32-exact); the
+            # cross-chunk sum runs in int32, so exactness holds at ANY row
+            # count (this was the old `n_pad < 2^24` eligibility gate)
+            gl_f = go_left.astype(jnp.float32)
+            bag_f = bag_c.astype(jnp.float32)
+            w3 = jnp.stack([gl_f, gl_f * bag_f, bag_f], 0)
+            cnt3 = lax.dot_general(w3, mask_f, (((1,), (0,)), ((), ())),
+                                   precision=_HIGH)             # (3, W)
+            lid_new = jnp.where(in_wave,
+                                jnp.where(go_left, lslot_r, rslot_r), lid_c)
+            return (go_left, in_wave & sortable_r, lid_new,
+                    jnp.rint(cnt3).astype(jnp.int32))
+
+        Cm = 1
+        while n // Cm > self._row_chunk and Cm < 1024:
+            Cm *= 2
+        bag_b = st.w_p[2] > 0.5
+        if Cm == 1:
+            go_left, sort_r, lid_p, cnt3 = decide(st.bins_p, st.lid_p, bag_b)
+        else:
+            ch = n // Cm
+            go_left, sort_r, lid_p, cnt3c = lax.map(
+                lambda a: decide(*a),
+                (st.bins_p.reshape(fw, Cm, ch).transpose(1, 0, 2),
+                 st.lid_p.reshape(Cm, ch), bag_b.reshape(Cm, ch)))
+            go_left = go_left.reshape(-1)
+            sort_r = sort_r.reshape(-1)
+            lid_p = lid_p.reshape(-1)
+            cnt3 = jnp.sum(cnt3c, axis=0, dtype=jnp.int32)
+        cnt3 = self._sync_counts3(cnt3)
+        lc_w = cnt3[0]
+        lc_bag = cnt3[1]
+        c_bag = cnt3[2]
+
+        # -- pass 2: window-order keys.  INVARIANT: every leaf's rows carry
         # key = 2 * (its window start) — strictly increasing with position,
         # so the stable sort is the identity on untouched leaves and
         # partitions each split window in place.  The children's starts are
         # already known pre-sort (s and s+lc), so both get final keys here.
-        # (2x of an f32-exact int is still exact — doubling only shifts the
-        # exponent.)
-        kstart = lax.dot_general(
-            mask_f, jnp.stack([ps.astype(jnp.float32),
-                               (ps + lc_w).astype(jnp.float32)], axis=1),
-            (((1,), (0,)), ((), ())), precision=_HIGH)          # (N, 2)
-        kl = 2 * jnp.rint(kstart[:, 0]).astype(jnp.int32)
-        kr = 2 * jnp.rint(kstart[:, 1]).astype(jnp.int32)
-        key_p = jnp.where(in_wave & sortable_r,
-                          jnp.where(go_left, kl, kr), st.key_p)
-        lid_p = jnp.where(in_wave,
-                          jnp.where(go_left, lslot_r, rslot_r), st.lid_p)
+        # Starts are routed through the contraction as hi/lo 12-bit planes
+        # (one nonzero per row -> each plane f32-exact at any N).
+        starts2 = jnp.stack([ps, ps + lc_w], axis=1)            # (W, 2)
+        planes = jnp.concatenate(
+            [(starts2 >> 12).astype(jnp.float32),
+             (starts2 & 0xFFF).astype(jnp.float32)], axis=1)    # (W, 4)
+
+        def keys(lid_old_c, go_c, sort_c, key_c):
+            mask_f = ((lid_old_c[:, None] == wi[None, :])
+                      & valid[None, :]).astype(jnp.float32)
+            ks = lax.dot_general(mask_f, planes, (((1,), (0,)), ((), ())),
+                                 precision=_HIGH)               # (ch, 4)
+            ki = jnp.rint(ks).astype(jnp.int32)
+            kl = 2 * ((ki[:, 0] << 12) + ki[:, 2])
+            kr = 2 * ((ki[:, 1] << 12) + ki[:, 3])
+            return jnp.where(sort_c, jnp.where(go_c, kl, kr), key_c)
+
+        if Cm == 1:
+            key_p = keys(st.lid_p, go_left, sort_r, st.key_p)
+        else:
+            ch = n // Cm
+            key_p = lax.map(
+                lambda a: keys(*a),
+                (st.lid_p.reshape(Cm, ch), go_left.reshape(Cm, ch),
+                 sort_r.reshape(Cm, ch),
+                 st.key_p.reshape(Cm, ch))).reshape(-1)
         # ---- ONE stable sort re-compacts every sortable split window
         # (skipped when the whole wave froze — the tree's bottom waves)
         do_sort = jnp.any(sortable)
@@ -432,11 +509,25 @@ class WaveTPUTreeLearner(CompactTPUTreeLearner):
         lh_w = jnp.where(valid, ph, oobh)
         rh_w = jnp.where(valid, rh, oobh)
 
+        pool, hl, hr = self._wave_member_hists(
+            st, sm_slot, sm_start, sm_cnt, valid, ph, lh_w, rh_w, left_small)
+        st = st._replace(hist_pool=pool)
+        hists2 = jnp.stack([hl, hr], 1).reshape((2 * self.W,) + hl.shape[1:])
+        return self._children_bookkeeping(
+            st, wi, valid, lslot, rslot, lc_bag, c_bag, li, ri2, ph, rh,
+            hists2, feature_mask)
+
+    def _wave_member_hists(self, st: WaveState, sm_slot, sm_start, sm_cnt,
+                           valid, ph, lh_w, rh_w, left_small):
+        """Smaller-child histograms for all wave members + sibling
+        subtraction + pool writes; returns (pool, hl, hr).  The sharded
+        subclass overrides this to reduce-scatter the W local histograms
+        over the feature axis before subtraction."""
         if "nohist" in self._ablate:
             shp = (self.W, self._hist_cols, self._hist_nbins, 3)
             hl = hr = jnp.zeros(shp, st.hist_pool.dtype)
-            pool = st.hist_pool
-        elif self._use_pallas:
+            return st.hist_pool, hl, hr
+        if self._use_pallas:
             h_small = self._segment_hists(st, sm_slot, sm_start, sm_cnt,
                                           valid)
             h_par = st.hist_pool[ph]                   # (W, F, B, 3)
@@ -445,38 +536,35 @@ class WaveTPUTreeLearner(CompactTPUTreeLearner):
             hl = jnp.where(lsm, h_small, h_large)
             hr = jnp.where(lsm, h_large, h_small)
             pool = st.hist_pool.at[lh_w].set(hl).at[rh_w].set(hr)
-        else:
-            def hist_member(pool, xs):
-                slot, start, cnt, phk, lhk, rhk, lsm, vk = xs
+            return pool, hl, hr
 
-                def compute(pool):
-                    hidx = self._bucket_idx(jnp.maximum(cnt, 1))
-                    h_small = lax.switch(hidx, self._hist_branches,
-                                         st.bins_p, st.w_p, st.lid_p, start,
-                                         cnt, slot)
-                    h_par = pool[phk]
-                    h_large = h_par - h_small
-                    hl = jnp.where(lsm, h_small, h_large)
-                    hr = jnp.where(lsm, h_large, h_small)
-                    return pool.at[lhk].set(hl).at[rhk].set(hr), (hl, hr)
+        def hist_member(pool, xs):
+            slot, start, cnt, phk, lhk, rhk, lsm, vk = xs
 
-                def skip(pool):
-                    z = jnp.zeros_like(pool[0])
-                    return pool, (z, z)
+            def compute(pool):
+                hidx = self._bucket_idx(jnp.maximum(cnt, 1))
+                h_small = lax.switch(hidx, self._hist_branches,
+                                     st.bins_p, st.w_p, st.lid_p, start,
+                                     cnt, slot)
+                h_par = pool[phk]
+                h_large = h_par - h_small
+                hl = jnp.where(lsm, h_small, h_large)
+                hr = jnp.where(lsm, h_large, h_small)
+                return pool.at[lhk].set(hl).at[rhk].set(hr), (hl, hr)
 
-                # only the valid prefix holds members — the cond keeps
-                # invalid slots from paying a histogram pass
-                return lax.cond(vk, compute, skip, pool)
+            def skip(pool):
+                z = jnp.zeros_like(pool[0])
+                return pool, (z, z)
 
-            pool, (hl, hr) = lax.scan(
-                hist_member, st.hist_pool,
-                (sm_slot, sm_start, sm_cnt, ph, lh_w, rh_w, left_small,
-                 valid))
-        st = st._replace(hist_pool=pool)
-        hists2 = jnp.stack([hl, hr], 1).reshape((2 * self.W,) + hl.shape[1:])
-        return self._children_bookkeeping(
-            st, wi, valid, lslot, rslot, lc_bag, c_bag, li, ri2, ph, rh,
-            hists2, feature_mask)
+            # only the valid prefix holds members — the cond keeps
+            # invalid slots from paying a histogram pass
+            return lax.cond(vk, compute, skip, pool)
+
+        pool, (hl, hr) = lax.scan(
+            hist_member, st.hist_pool,
+            (sm_slot, sm_start, sm_cnt, ph, lh_w, rh_w, left_small,
+             valid))
+        return pool, hl, hr
 
     def _segment_hists(self, st: WaveState, sm_slot, sm_start, sm_cnt,
                        valid):
@@ -490,8 +578,8 @@ class WaveTPUTreeLearner(CompactTPUTreeLearner):
         rb = self._seg_rb
         # sortable smaller-child windows are disjoint (<= n_pad rows total);
         # frozen members scan their shared parent span (<= wave cutoff each)
-        wc = min(self._wave_cutoff, self.n_pad)
-        T = self.n_pad // rb + W + W * (wc // rb + 2) + 1
+        wc = min(self._wave_cutoff, self._rows_len())
+        T = self._rows_len() // rb + W + W * (wc // rb + 2) + 1
         first_blk = jnp.where(valid, sm_start // rb, 0)
         last_blk = jnp.where(
             valid, (sm_start + jnp.maximum(sm_cnt, 1) - 1) // rb, 0)
@@ -545,7 +633,7 @@ class WaveTPUTreeLearner(CompactTPUTreeLearner):
         the sequential learner's — frozen (shared) windows are always
         ≤ cutoff, so a sort-mode stall never reorders another leaf's rows.
         """
-        fw, n = self.fw, self.n_pad
+        fw, n = self.fw, self._rows_len()
 
         def branch(bins_p, w_p, rid_p, lid_p, s, c, leaf, feat, thr, dleft,
                    is_cat, cat_bits, l0, r0):
@@ -631,14 +719,16 @@ class WaveTPUTreeLearner(CompactTPUTreeLearner):
                        st.rid_p, st.lid_p, s, c, top, feat, thr, dleft,
                        is_cat, cat_bits, l0, r0)
         st = st._replace(bins_p=bins_p, w_p=w_p, rid_p=rid_p, lid_p=lid_p)
+        lc_bag, c_bag = self._sync_counts(lc_bag, c_bag)
         # smaller-child histogram + sibling subtraction
         left_small = lc_bag <= (c_bag - lc_bag)
         sm_slot = jnp.where(left_small, l0, r0)
         sm_start = jnp.where(left_small, ls, rs)
         sm_cnt = jnp.where(left_small, lw, rw)
         hidx = self._bucket_idx(jnp.maximum(sm_cnt, 1))
-        h_small = lax.switch(hidx, self._hist_branches, st.bins_p, st.w_p,
-                             st.lid_p, sm_start, sm_cnt, sm_slot)
+        h_small = self._reduce_hist(
+            lax.switch(hidx, self._hist_branches, st.bins_p, st.w_p,
+                       st.lid_p, sm_start, sm_cnt, sm_slot))
         ph = st.hslot[top]
         h_par = st.hist_pool[ph]
         h_large = h_par - h_small
@@ -662,83 +752,141 @@ class WaveTPUTreeLearner(CompactTPUTreeLearner):
         (`serial_tree_learner.cpp:185-218`), splitting on demand when the
         replay reaches a leaf the growth never split.
 
-        Two-level loop: the INNER sim carries only small (M,)-shaped state
-        (~20 µs/pop); the OUTER loop — one iteration per speculation miss,
-        usually exactly one total — re-enters after performing the missing
-        split."""
+        Two-level loop; the INNER sim pops a whole BATCH per iteration
+        instead of one leaf.  Every grown node's children's gains are
+        already known (``cand_f``), so after sorting the available set by
+        (gain desc, leaf-index asc) — the reference's pop priority — the
+        leading prefix can pop at once as long as each member's gain
+        strictly exceeds every child gain revealed by the members before it
+        (such a child could never jump ahead of them); gain TIES against a
+        revealed child stop the prefix, deferring to the next iteration
+        where the child is available with its leaf index assigned, so the
+        lowest-leaf-index tie-break (`serial_tree_learner.cpp:505-520`) is
+        preserved exactly.  Real trees pop in a few descending-gain runs,
+        so ~254 sequential pops (~28 ms of tiny-op latency on the real
+        chip) become ~a dozen batched iterations.
+
+        The OUTER loop — one iteration per speculation miss, usually zero
+        total — re-enters after performing a missing split."""
         M, budget = self.M, self.budget
-        BIG = jnp.int32(1 << 30)
         OOB = jnp.int32(M + 7)
+        NEG = jnp.finfo(jnp.float32).min
 
         def outer_cond(carry):
             return carry[-1] == 0  # 0 = need (another) sim pass
 
         def outer_body(carry):
-            st, ga, refidx, pops, leaf_cnt, poprec, _ = carry
+            st, avail_n, refidx, pops, leaf_cnt, poprec, stalls, _ = carry
             gains = st.cand_f[:, CF_GAIN].astype(self._acc)
             split_m = st.split_m
             child0 = st.child0
-            # nodes split since the last pass keep their ga entry; fresh
-            # reveals are written at pop time below
+            iota = jnp.arange(M, dtype=jnp.int32)
+            # ONE gain-priority sort per pass (gains are fixed within a
+            # pass; only availability changes between iterations) — the
+            # slot-ascending secondary key is only a stand-in for the
+            # refidx tie-break, so batches containing an exact gain tie
+            # fall back to a single exact-priority pop
+            _, _, order = lax.sort([-gains, iota, iota], num_keys=2,
+                                   is_stable=True)
+            g_o = gains[order]
+            sp_o = split_m[order]
+            c0_o = child0[order]
+            cg_o = jnp.where(sp_o,
+                             jnp.maximum(gains[c0_o], gains[c0_o + 1]),
+                             NEG)
+
             # ---- inner sim: flag 0 = running, 1 = stall, 2 = done
             def icond(ic):
                 return ic[-2] == 0
 
             def ibody(ic):
-                ga, refidx, pops, leaf_cnt, poprec, _, _ = ic
-                mg = jnp.max(ga)
-                proceed = (mg > 0.0) & (pops < budget)
-                # lowest-leaf-index tie-break
-                # (`serial_tree_learner.cpp:505-520`)
-                tb = jnp.where(ga == mg, refidx, BIG)
-                top = jnp.argmin(tb).astype(jnp.int32)
-                is_split = split_m[top]
-                pop = proceed & is_split
-                flag = jnp.where(proceed,
-                                 jnp.where(is_split, jnp.int32(0),
-                                           jnp.int32(1)),
-                                 jnp.int32(2)).astype(jnp.int32)
-                c0 = child0[top]
-                topw = jnp.where(pop, top, OOB)
-                c0w = jnp.where(pop, c0, OOB)
-                ga = ga.at[jnp.stack([topw, c0w, c0w + 1])].set(
-                    jnp.stack([-jnp.inf, gains[c0], gains[c0 + 1]]),
-                    mode="drop")
-                refidx2 = refidx.at[jnp.stack([c0w, c0w + 1])].set(
-                    jnp.stack([refidx[top], leaf_cnt]), mode="drop")
-                popsw = jnp.where(pop, pops, jnp.int32(budget + 7))
-                poprec = poprec.at[popsw].set(
-                    jnp.stack([top, refidx[top]]), mode="drop")
-                return (ga, refidx2, pops + pop.astype(jnp.int32),
-                        leaf_cnt + pop.astype(jnp.int32), poprec, flag, top)
+                avail_n, refidx, pops, leaf_cnt, poprec, _, _ = ic
+                cand = avail_n[order]
+                gc = jnp.where(cand, g_o, NEG)
+                # exclusive running max of revealed-child gains over the
+                # available candidates
+                pmax = lax.cummax(jnp.concatenate(
+                    [jnp.full((1,), NEG, cg_o.dtype),
+                     jnp.where(cand, cg_o, NEG)[:-1]]))
+                apos = jnp.cumsum(cand.astype(jnp.int32)) - 1
+                ok = cand & (g_o > 0.0) & sp_o & (g_o > pmax) & \
+                    (apos < budget - pops)
+                alive = jnp.cumprod((ok | ~cand).astype(jnp.int32)) == 1
+                inb = ok & alive
+                # ANY exact gain tie among available candidates -> single
+                # exact pop (covers batch-internal ties AND a tie between a
+                # prefix member and a blocked/unsplit candidate with lower
+                # refidx; a plateau of duplicated-feature gains degrades to
+                # sequential pops, which is the exact semantics)
+                pa = lax.cummax(jnp.concatenate(
+                    [jnp.full((1,), -1, jnp.int32),
+                     jnp.where(cand, iota, -1)[:-1]]))
+                tie = jnp.any(cand & (pa >= 0) & (g_o > 0.0) &
+                              (g_o == g_o[jnp.maximum(pa, 0)]))
+                g0 = jnp.max(gc)
+                # exact-priority top: lowest refidx among max-gain avail
+                tb = jnp.where(cand & (g_o == g0), refidx[order],
+                               jnp.int32(1 << 30))
+                pstar = jnp.argmin(tb).astype(jnp.int32)
+                proceed0 = (g0 > 0.0) & (pops < budget)
+                # single-pop mode: a gain tie inside the prefix, or an
+                # empty prefix while the exact top is poppable (a same-gain
+                # unsplit node ahead of it blocked the prefix)
+                npop0 = jnp.sum(inb.astype(jnp.int32))
+                single = tie | ((npop0 == 0) & proceed0 & sp_o[pstar])
+                inb = jnp.where(single, (iota == pstar) & sp_o[pstar], inb)
+                npop = jnp.sum(inb.astype(jnp.int32)).astype(jnp.int32)
+                flag = jnp.where(
+                    npop > 0, jnp.int32(0),
+                    jnp.where(proceed0 & ~sp_o[pstar], jnp.int32(1),
+                              jnp.int32(2)))
+                top = order[pstar]
+                tie = single
+                # ---- execute the batch (apos == pop position: the prefix
+                # property makes every earlier available node popped; in
+                # tie mode the single pop is position 0 by construction)
+                bpos = jnp.where(tie, 0, apos)
+                nd = jnp.where(inb, order, OOB)
+                c0b = jnp.where(inb, c0_o, OOB)
+                ref_nd = refidx[jnp.where(inb, order, 0)]
+                poprec = poprec.at[jnp.where(inb, pops + bpos,
+                                             jnp.int32(budget + 7))].set(
+                    jnp.stack([nd, ref_nd], axis=1), mode="drop")
+                refidx = refidx.at[c0b].set(ref_nd, mode="drop") \
+                               .at[c0b + 1].set(leaf_cnt + bpos,
+                                                mode="drop")
+                avail_n = avail_n.at[nd].set(False, mode="drop") \
+                                 .at[c0b].set(True, mode="drop") \
+                                 .at[c0b + 1].set(True, mode="drop")
+                return (avail_n, refidx, pops + npop, leaf_cnt + npop,
+                        poprec, flag, top)
 
             ic = lax.while_loop(
                 icond, ibody,
-                (ga, refidx, pops, leaf_cnt, poprec,
+                (avail_n, refidx, pops, leaf_cnt, poprec,
                  jnp.asarray(0, jnp.int32), jnp.asarray(0, jnp.int32)))
-            ga, refidx, pops, leaf_cnt, poprec, flag, top = ic
+            avail_n, refidx, pops, leaf_cnt, poprec, flag, top = ic
 
-            def do_stall(args):
-                st, ga = args
-                st2 = self._stall_split(st, top, feature_mask)
+            def do_stall(s):
                 # the stalled node is now split; it stays available with
                 # its (unchanged) gain — the next pass pops it
-                return st2, ga
+                return self._stall_split(s, top, feature_mask)
 
-            st, ga = lax.cond(flag == 1, do_stall, lambda a: a, (st, ga))
+            st = lax.cond(flag == 1, do_stall, lambda s: s, st)
             # stall -> another sim pass (flag back to 0); done stays 2
-            return (st, ga, refidx, pops, leaf_cnt, poprec,
+            return (st, avail_n, refidx, pops, leaf_cnt, poprec,
+                    stalls + (flag == 1).astype(jnp.int32),
                     jnp.where(flag == 1, jnp.int32(0), flag))
 
-        ga0 = jnp.full(M, -jnp.inf, self._acc).at[0].set(
-            st.cand_f[0, CF_GAIN].astype(self._acc))
-        init = (st, ga0,
+        avail0 = jnp.zeros(M, bool).at[0].set(True)
+        init = (st, avail0,
                 jnp.full(M, -1, jnp.int32).at[0].set(0),
                 jnp.asarray(0, jnp.int32),
                 jnp.asarray(1, jnp.int32),
                 jnp.zeros((budget, 2), jnp.int32),
+                jnp.asarray(0, jnp.int32),
                 jnp.asarray(0, jnp.int32))
-        st, ga, refidx, pops, leaf_cnt, poprec, _ = \
+        st, avail_n, refidx, pops, leaf_cnt, poprec, stalls, _ = \
             lax.while_loop(outer_cond, outer_body, init)
         pop_nodes, pop_ref = poprec[:, 0], poprec[:, 1]
         # final frontier = revealed (root or child of a popped node) and
@@ -751,7 +899,7 @@ class WaveTPUTreeLearner(CompactTPUTreeLearner):
             .at[c0p + 1].set(True, mode="drop")
         popped = jnp.zeros(M, bool).at[ndw].set(True, mode="drop")
         avail = revealed & ~popped
-        return st, avail, refidx, pops, pop_nodes, pop_ref
+        return st, avail, refidx, pops, pop_nodes, pop_ref, stalls
 
     # -- whole tree -----------------------------------------------------------
 
@@ -764,12 +912,18 @@ class WaveTPUTreeLearner(CompactTPUTreeLearner):
         st = self._init_root_wave(bins_p, grad, hess, bag, feature_mask)
 
         def gcond(s):
-            return (s.num_splits < self.budget) & \
+            return (s.num_splits < self.grow_budget) & \
                 (jnp.max(self._pool_gains(s)) > 0.0)
 
         st = lax.while_loop(gcond, lambda s: self._wave_body(s, feature_mask),
                             st)
-        st, avail, refidx, pops, pop_nodes, pop_ref = self._replay(
+        return self._emit_tree_wave(st, feature_mask)
+
+    def _emit_tree_wave(self, st: WaveState, feature_mask):
+        """Exact greedy replay + host-record emission + speculative-leaf
+        mapping (shared by the serial and sharded wave learners — the
+        replay operates on replicated node state only)."""
+        st, avail, refidx, pops, pop_nodes, pop_ref, _stalls = self._replay(
             st, feature_mask)
 
         # ---- emit host records in pop order
@@ -805,7 +959,18 @@ class WaveTPUTreeLearner(CompactTPUTreeLearner):
         for _ in range(max(1, (self.M - 1).bit_length())):
             T = T[T]
         slot2ref = jnp.where(final[T], refidx[T], 0)
-        leaf_ref = lookup_int(slot2ref, st.lid_p)
+        # chunked lookup: the (rows, M_pad) one-hot transient is bounded to
+        # ~2^17 rows per step regardless of N (at 10.5M rows an unchunked
+        # one-hot would be ~24 GB)
+        Cl = 1
+        while self._rows_len() // Cl > (1 << 17) and Cl < 1024:
+            Cl *= 2
+        if Cl == 1:
+            leaf_ref = lookup_int(slot2ref, st.lid_p)
+        else:
+            leaf_ref = lax.map(
+                lambda lid_c: lookup_int(slot2ref, lid_c),
+                st.lid_p.reshape(Cl, self._rows_len() // Cl)).reshape(-1)
         # descatter to original row order by sorting on rid (a 2-lane sort
         # is ~3x cheaper than the equivalent scatter on TPU)
         leaf_id = lax.sort([st.rid_p, leaf_ref], num_keys=1)[1]
@@ -824,27 +989,58 @@ class WaveTPUTreeLearner(CompactTPUTreeLearner):
                                 feature_mask)
 
 
-def wave_eligible(cfg: Config, data: _ConstructedDataset) -> bool:
-    """Gates for the wave learner; ineligible configs use the sequential
-    compact learner.  Sizing uses the BUNDLED (EFB) column layout when a
-    bundle exists — that is what the learner actually runs on."""
-    if cfg.tree_learner != "serial" or data.max_num_bin > 256:
-        return False
-    if int(data.num_data_padded) >= (1 << 24):
-        return False  # f32-exact count contractions need N < 2^24
+def wave_budget_reason(cfg: Config, n_pad: int, f_pad: int, b: int
+                       ) -> Optional[str]:
+    """Shape/byte-budget gates shared by the serial and sharded wave
+    learners (``n_pad`` is the PER-DEVICE row count for sharded use)."""
+    if f_pad // 4 > 64:
+        return f"{f_pad} padded columns > 256 (per-row word extraction is " \
+               "a masked sum over words)"
+    budget = max(int(cfg.num_leaves), 2) - 1
+    W = min(int(cfg.tpu_wave_width), budget)
+    grow = min(budget + int(np.ceil(budget * float(cfg.tpu_wave_overshoot))),
+               2 * budget)
+    M = 1 + 2 * (grow + budget)
+    h_bytes = (grow + budget + 2) * f_pad * b * 3 * 4
+    scan_bytes = 2 * W * f_pad * b * 3 * 4
+    # per-wave transients (round-3 advisor): the (rows, W) f32 wave-member
+    # mask is CHUNKED to 2^20 rows (lax.map in _wave_body) and the
+    # leaf-ref lookup one-hot to 2^17 rows, so neither scales with N; the
+    # (N,) derived per-row columns do
+    m_pad = ((M + 127) // 128) * 128
+    mask_bytes = min(n_pad, 1 << 20) * W * 4 + n_pad * 12
+    lookup_bytes = min(n_pad, 1 << 17) * m_pad * 4
+    # double-buffered sort operands (key + fw words + 3 weights + rid + lid)
+    sort_bytes = 2 * (f_pad // 4 + 6) * n_pad * 4
+    total = h_bytes + scan_bytes + mask_bytes + lookup_bytes + sort_bytes
+    if total > int(cfg.tpu_wave_max_bytes):
+        return "estimated working set %.1f GB > tpu_wave_max_bytes %.1f GB" \
+            % (total / 2**30, int(cfg.tpu_wave_max_bytes) / 2**30)
+    return None
+
+
+def wave_ineligible_reason(cfg: Config, data: _ConstructedDataset
+                           ) -> Optional[str]:
+    """Why the wave learner cannot run this config (None = eligible).
+    Sizing uses the BUNDLED (EFB) column layout when a bundle exists —
+    that is what the learner actually runs on."""
+    if cfg.tree_learner != "serial":
+        return f"tree_learner={cfg.tree_learner} (wave is serial-only)"
+    if data.max_num_bin > 256:
+        return f"max_num_bin={data.max_num_bin} > 256 (bin codes must pack " \
+               "4-per-word)"
     bundle = getattr(data, "bundle", None)
     if bundle is not None:
         from .dataset import _round_up
         f_pad = _round_up(bundle.num_groups, data.FEATURE_TILE)
         b = max(int(data.max_num_bin), int(bundle.max_group_bin))
         if b > 256:
-            return False
+            return f"EFB bundle max bin {b} > 256"
     else:
         f_pad = data.bins.shape[0]
         b = int(data.max_num_bin)
-    if f_pad // 4 > 64:
-        return False  # per-row word extraction is a masked sum over words
-    budget = max(int(cfg.num_leaves), 2) - 1
-    h_bytes = (2 * budget + 2) * f_pad * b * 3 * 4
-    scan_bytes = 2 * min(int(cfg.tpu_wave_width), budget) * f_pad * b * 3 * 4
-    return h_bytes + scan_bytes <= int(cfg.tpu_wave_max_bytes)
+    return wave_budget_reason(cfg, int(data.num_data_padded), f_pad, b)
+
+
+def wave_eligible(cfg: Config, data: _ConstructedDataset) -> bool:
+    return wave_ineligible_reason(cfg, data) is None

@@ -38,9 +38,31 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..binning import MISSING_NAN, MISSING_NONE, MISSING_ZERO
+from .histogram import _on_tpu
 
 K_EPSILON = 1e-15   # `meta.h:38`
 K_MIN_SCORE = -np.inf
+
+
+def _scan_by_dot(dt, b: int) -> bool:
+    """On TPU, bin-axis prefix/suffix sums run as triangular-matrix MXU
+    contractions: XLA's cumsum lowers to an O(B)-depth scan that costs
+    ~1.8 ms per million elements on v5e (profiling/profile_primitives.py)
+    while the equivalent (.., B)x(B, B) dot is ~free.  The summation
+    ORDER differs from the reference's sequential accumulation, so
+    near-tie thresholds can flip vs the CPU path — the same accepted
+    regime as the bf16-term histograms (`docs/GPU-Performance.rst:137-141`
+    documents the identical CPU-vs-GPU deltas for the reference);
+    accuracy/accuracy_tpu.py records the measured effect.  CPU keeps the
+    sequential order (and with it bit-parity with the reference CLI)."""
+    return _on_tpu() and dt == jnp.float32 and b <= 1024
+
+
+def _prefix_dot(xs, incl_mat):
+    """Σ_b xs[..., b] · M[b, t] with full f32 accuracy on the MXU."""
+    return jax.lax.dot_general(
+        xs, incl_mat, (((xs.ndim - 1,), (0,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST)
 
 
 class SplitCandidates(NamedTuple):
@@ -154,13 +176,21 @@ def find_best_splits(hist: jax.Array, sum_gradients: jax.Array,
               (two & is_nan & (bins_i >= nb - 1)) | (bins_i >= nb)
     keep = (~excl_m1).astype(dt)
     # right(t) = suffix sum over bins > t
-    cg = jnp.cumsum((hg * keep)[:, ::-1], axis=1)[:, ::-1]
-    ch = jnp.cumsum((hh * keep)[:, ::-1], axis=1)[:, ::-1]
-    cc = jnp.cumsum((hc * keep)[:, ::-1], axis=1)[:, ::-1]
-    zero_col = jnp.zeros((f, 1), dtype=dt)
-    rg_m1 = jnp.concatenate([cg[:, 1:], zero_col], axis=1)     # (F, B) at thr=t
-    rh_m1 = jnp.concatenate([ch[:, 1:], zero_col], axis=1) + K_EPSILON
-    rc_m1 = jnp.concatenate([cc[:, 1:], zero_col], axis=1)
+    if _scan_by_dot(dt, b):
+        gt = jnp.asarray(np.tril(np.ones((b, b), np.float32), -1))
+        sums = _prefix_dot(jnp.stack([hg * keep, hh * keep, hc * keep],
+                                     axis=-2), gt)              # (F, 3, B)
+        rg_m1 = sums[..., 0, :]
+        rh_m1 = sums[..., 1, :] + K_EPSILON
+        rc_m1 = sums[..., 2, :]
+    else:
+        cg = jnp.cumsum((hg * keep)[:, ::-1], axis=1)[:, ::-1]
+        ch = jnp.cumsum((hh * keep)[:, ::-1], axis=1)[:, ::-1]
+        cc = jnp.cumsum((hc * keep)[:, ::-1], axis=1)[:, ::-1]
+        zero_col = jnp.zeros((f, 1), dtype=dt)
+        rg_m1 = jnp.concatenate([cg[:, 1:], zero_col], axis=1)  # (F, B) at thr=t
+        rh_m1 = jnp.concatenate([ch[:, 1:], zero_col], axis=1) + K_EPSILON
+        rc_m1 = jnp.concatenate([cc[:, 1:], zero_col], axis=1)
     lg_m1 = total_g - rg_m1
     lh_m1 = total_h - rh_m1
     lc_m1 = total_n - rc_m1
@@ -206,9 +236,17 @@ def find_best_splits(hist: jax.Array, sum_gradients: jax.Array,
     excl_p1 = (is_zero & (bins_i == d_bin)) | \
               (is_nan & (bins_i >= nb - 1)) | (bins_i >= nb)
     keep_p = (~excl_p1).astype(dt)
-    lg_p1 = jnp.cumsum(hg * keep_p, axis=1)                    # left(t): bins<=t
-    lh_p1 = jnp.cumsum(hh * keep_p, axis=1) + K_EPSILON
-    lc_p1 = jnp.cumsum(hc * keep_p, axis=1)
+    if _scan_by_dot(dt, b):
+        le = jnp.asarray(np.triu(np.ones((b, b), np.float32)))
+        sums_p = _prefix_dot(jnp.stack([hg * keep_p, hh * keep_p,
+                                        hc * keep_p], axis=-2), le)
+        lg_p1 = sums_p[..., 0, :]
+        lh_p1 = sums_p[..., 1, :] + K_EPSILON
+        lc_p1 = sums_p[..., 2, :]
+    else:
+        lg_p1 = jnp.cumsum(hg * keep_p, axis=1)                # left(t): bins<=t
+        lh_p1 = jnp.cumsum(hh * keep_p, axis=1) + K_EPSILON
+        lc_p1 = jnp.cumsum(hc * keep_p, axis=1)
     rg_p1 = total_g - lg_p1
     rh_p1 = total_h - lh_p1
     rc_p1 = total_n - lc_p1
@@ -253,6 +291,75 @@ def find_best_splits(hist: jax.Array, sum_gradients: jax.Array,
         right_sum_h=total_h - lh_b - K_EPSILON,
         right_cnt=total_n - lc_b,
         left_output=lo_b, right_output=ro_b)
+
+
+def forced_split_info(hrow: jax.Array, sum_g: jax.Array, sum_h: jax.Array,
+                      cnt: jax.Array, *, threshold: int, num_bin: int,
+                      missing_type: int, default_bin: int, is_cat: bool,
+                      lambda_l1: float, lambda_l2: float,
+                      max_delta_step: float, min_gain_to_split: float):
+    """Split info at a FORCED (feature, threshold) —
+    ``FeatureHistogram::GatherInfoForThreshold``
+    (`src/treelearner/feature_histogram.hpp:273-413`).
+
+    hrow: (B, 3) histogram row of the forced feature; threshold/metadata are
+    STATIC (the forced-split tree is fixed at config time).  Feasibility
+    limits (min_data / min_hessian) are BYPASSED like the reference; only
+    the gain-vs-no-split check applies (gain <= shift ⇒ the forced split is
+    refused and the whole remaining forced queue aborts,
+    `serial_tree_learner.cpp:612-616`).
+
+    Returns (gain, left_g, left_h_eps, left_cnt, right_g, right_h_eps,
+    right_cnt, left_out, right_out, valid); *_h_eps carry the same epsilon
+    convention as ``find_best_splits``'s packed rows (raw + K_EPSILON is
+    subtracted back by the caller's storage convention).
+    """
+    dt = hrow.dtype
+    total_g = sum_g.astype(dt)
+    total_h = sum_h.astype(dt) + 2.0 * K_EPSILON
+    total_n = cnt.astype(dt)
+    gain_shift = leaf_split_gain(total_g, total_h, lambda_l1, lambda_l2,
+                                 max_delta_step)
+    min_gain_shift = gain_shift + min_gain_to_split
+    b_idx = np.arange(hrow.shape[0])
+    if is_cat:
+        # one-hot categorical forced split (`feature_histogram.hpp:359-413`)
+        lg = hrow[threshold, 0]
+        lh = hrow[threshold, 1] + K_EPSILON
+        lc = hrow[threshold, 2]
+        rg = total_g - lg
+        rh = total_h - lh
+        rc = total_n - lc
+        # NOTE: the reference computes the left term of the gain check with
+        # the RIGHT hessian (`feature_histogram.hpp:389-394`) — mirrored
+        # verbatim so forced-categorical acceptance matches
+        cur = leaf_split_gain(rg, rh, lambda_l1, lambda_l2, max_delta_step) \
+            + leaf_split_gain(lg, rh, lambda_l1, lambda_l2, max_delta_step)
+        ok = threshold < num_bin
+    else:
+        # right = bins >= threshold, never bin 0, skipping the default bin
+        # for MissingType::Zero and the NaN bin for MissingType::NaN
+        # (`feature_histogram.hpp:284-322`)
+        m = (b_idx >= max(int(threshold), 1)) & (b_idx < num_bin)
+        if missing_type == MISSING_ZERO:
+            m &= b_idx != default_bin
+        elif missing_type == MISSING_NAN:
+            m &= b_idx <= num_bin - 2
+        mv = jnp.asarray(m, dt)
+        rg = jnp.sum(hrow[:, 0] * mv)
+        rh = jnp.sum(hrow[:, 1] * mv) + K_EPSILON
+        rc = jnp.sum(hrow[:, 2] * mv)
+        lg = total_g - rg
+        lh = total_h - rh
+        lc = total_n - rc
+        cur = leaf_split_gain(lg, lh, lambda_l1, lambda_l2, max_delta_step) \
+            + leaf_split_gain(rg, rh, lambda_l1, lambda_l2, max_delta_step)
+        ok = True
+    valid = ok & ~jnp.isnan(cur) & (cur > min_gain_shift)
+    lo = calculate_leaf_output(lg, lh, lambda_l1, lambda_l2, max_delta_step)
+    ro = calculate_leaf_output(rg, rh, lambda_l1, lambda_l2, max_delta_step)
+    gain = cur - min_gain_shift
+    return gain, lg, lh, lc, rg, rh, rc, lo, ro, valid
 
 
 def best_over_features(cands: SplitCandidates):

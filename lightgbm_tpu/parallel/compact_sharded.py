@@ -141,6 +141,9 @@ class ShardedCompactLearner(CompactTPUTreeLearner):
         """Global bagged counts from the local partition's sums."""
         return (lax.psum(lc_bag, self.axis), lax.psum(c_bag, self.axis))
 
+    def _global_scalar(self, v):
+        return lax.psum(v, self.axis)
+
     def _child_best_rows(self, hist_left, hist_right, crow_f, fmask_pad,
                          depth_ok, constraints):
         hist2 = jnp.stack([hist_left, hist_right])
@@ -285,9 +288,9 @@ class ShardedCompactLearner(CompactTPUTreeLearner):
         local_root = self._hist_branches[-1](bins_p, w, lid0, jnp.int32(0),
                                              jnp.int32(n), jnp.int32(0))
         root_hist = self._reduce_hist(local_root)   # (fs, B, 3) scattered
-        sum_g = lax.psum(jnp.sum((grad * bag).astype(acc)), axis)
-        sum_h = lax.psum(jnp.sum((hess * bag).astype(acc)), axis)
-        cnt = lax.psum(jnp.sum(bag.astype(acc)), axis)
+        sum_g = self._global_scalar(jnp.sum((grad * bag).astype(acc)))
+        sum_h = self._global_scalar(jnp.sum((hess * bag).astype(acc)))
+        cnt = self._global_scalar(jnp.sum(bag.astype(acc)))
 
         md = int(self.cfg.max_depth)
         depth_ok = jnp.asarray([True if md <= 0 else md > 0])

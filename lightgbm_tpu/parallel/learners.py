@@ -46,6 +46,14 @@ def apply_parallel_sharding(gbdt, mesh: Mesh, mode: str) -> None:
     if hasattr(gbdt, "_flush_pending"):
         gbdt._flush_pending()
     learner = gbdt.learner
+    if getattr(learner, "_forced", None):
+        # the reference applies ForceSplits in its parallel learners too
+        # (they subclass SerialTreeLearner); the sharded learners here do
+        # not yet — refuse loudly rather than silently train a different
+        # model
+        raise NotImplementedError(
+            "forcedsplits_filename is not supported with "
+            "tree_learner=data|feature|voting yet; use tree_learner=serial")
     mesh_size = max(int(np.prod(mesh.devices.shape)), 1)
     if mode in ("data", "voting") and learner.data.max_num_bin <= 256 \
             and learner.data.num_data_padded % mesh_size == 0 \
@@ -55,13 +63,43 @@ def apply_parallel_sharding(gbdt, mesh: Mesh, mode: str) -> None:
         # (`compact_sharded.py`)
         from .compact_sharded import (ShardedCompactLearner,
                                       ShardedVotingLearner)
-        cls = ShardedVotingLearner if mode == "voting" \
-            else ShardedCompactLearner
+        if mode == "voting":
+            cls = ShardedVotingLearner
+        else:
+            # data-parallel rides the frontier-wave learner where eligible
+            # (the reference templates its parallel learners over its
+            # fastest serial learner, `data_parallel_tree_learner.cpp:257`)
+            from .wave_sharded import (ShardedWaveLearner,
+                                       wave_sharded_eligible)
+            cls = ShardedWaveLearner if wave_sharded_eligible(
+                learner.cfg, learner.data, mesh_size) \
+                else ShardedCompactLearner
         gbdt.learner = cls(learner.cfg, learner.data, mesh)
         _place_row_arrays(gbdt, mesh, mode)
         gbdt._mesh = mesh
         gbdt._parallel_mode = mode
         return
+    if mode == "feature" and learner.data.max_num_bin <= 256:
+        from ..learner_wave import wave_budget_reason
+        from .feature_sharded import (FeatureShardedCompactLearner,
+                                      FeatureShardedWaveLearner,
+                                      feature_sharded_eligible)
+        if feature_sharded_eligible(learner.cfg, learner.data, mesh_size):
+            # rows are REPLICATED in feature-parallel, so the wave variant
+            # must pass the serial wave gates at the FULL row count and
+            # width (wide datasets use the feature-sharded compact learner
+            # — its scans are feature-sliced either way)
+            wave_ok = (learner.cfg.tpu_learner in ("auto", "wave")
+                       and wave_budget_reason(
+                           learner.cfg, int(learner.data.num_data_padded),
+                           learner.data.bins.shape[0],
+                           int(learner.data.max_num_bin)) is None)
+            cls = FeatureShardedWaveLearner if wave_ok \
+                else FeatureShardedCompactLearner
+            gbdt.learner = cls(learner.cfg, learner.data, mesh)
+            gbdt._mesh = mesh
+            gbdt._parallel_mode = mode
+            return
     if type(learner) is not TPUTreeLearner:
         # feature-parallel / >256-bin fallbacks drape GSPMD over the masked
         # learner — the compact learner's packed-bin cache and global-axis

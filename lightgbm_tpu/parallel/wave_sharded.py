@@ -1,0 +1,175 @@
+"""Data-parallel frontier-wave learner: wave growth over row shards.
+
+Round-3's ``ShardedCompactLearner`` wraps the SEQUENTIAL compact learner —
+254 dependent split steps per tree, each paying the collective + bookkeeping
+floor.  This subclass ports the frontier-wave growth
+(`lightgbm_tpu/learner_wave.py`) into the shard_map program, mirroring the
+reference's template of parallelizing its fastest serial learner
+(`src/treelearner/data_parallel_tree_learner.cpp:257-258` instantiates over
+the serial learner):
+
+  * every device runs the wave partition over its LOCAL rows (the one
+    stable sort per wave sorts the local shard);
+  * the W smaller-child histograms of a wave are ``psum_scatter``-ed over
+    the feature axis in ONE batched collective per wave — W× fewer
+    exchanges than the sequential sharded learner
+    (`data_parallel_tree_learner.cpp:146-161` reduce-scatters per split);
+  * the 2W children's best splits come from per-device feature-slice scans
+    merged by a tiny all_gather (``SyncUpGlobalBestSplit``,
+    `parallel_tree_learner.h:186-209`);
+  * node/candidate state stays replicated, so the exact greedy replay (and
+    its leaf numbering) is pure replicated bookkeeping — no communication.
+
+Exactness: the records stream is identical to the serial wave learner's
+(`tests/test_parallel.py::test_wave_sharded_records_match_serial`).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..config import Config
+from ..dataset import _ConstructedDataset
+from ..learner_wave import WaveState, WaveTPUTreeLearner, \
+    wave_budget_reason
+from .compact_sharded import ShardedCompactLearner, shard_map
+
+
+class ShardedWaveLearner(ShardedCompactLearner, WaveTPUTreeLearner):
+    """`tree_learner=data` on the frontier-wave learner (see module
+    docstring).  MRO: sharded seams (_reduce_hist/_sync_counts/
+    _best_rows_global) override the serial ones; wave growth/replay comes
+    from WaveTPUTreeLearner."""
+
+    def __init__(self, cfg: Config, data: _ConstructedDataset, mesh: Mesh,
+                 hist_backend: str = "auto"):
+        ShardedCompactLearner.__init__(self, cfg, data, mesh, hist_backend)
+        # wave bookkeeping over the PADDED feature axis (no EFB bundles in
+        # the sharded path; metadata was padded by the sharded __init__)
+        self._init_wave_dims(cfg)
+        self.fw_col = jnp.arange(self.f_pad, dtype=jnp.int32)
+        self.fw_goff = jnp.zeros(self.f_pad, jnp.int32)
+        self.fw_bnd = jnp.zeros(self.f_pad, jnp.int32)
+        self._jit_tree_w = None
+
+    # -- sharded seams used by the wave body ---------------------------------
+
+    def _sync_counts3(self, cnt3):
+        # row 0 (left ROW count) is local window geometry; rows 1-2 are
+        # the global bagged counts every device must agree on
+        bagged = lax.psum(cnt3[1:], self.axis)
+        return jnp.concatenate([cnt3[:1], bagged], axis=0)
+
+    def _cand_rows_batch(self, hists, sg, sh, cn, feature_mask, depth_ok,
+                         constraints):
+        """(K, fs, B, 3) scattered child histograms -> replicated best
+        rows via feature-slice scans + all_gather."""
+        return self._best_rows_global(hists, (sg, sh, cn), feature_mask,
+                                      depth_ok, constraints)
+
+    def _wave_member_hists(self, st: WaveState, sm_slot, sm_start, sm_cnt,
+                           valid, ph, lh_w, rh_w, left_small):
+        """Local per-member histograms over the full padded feature axis,
+        ONE batched psum_scatter over features per wave, then subtraction
+        against the (scattered) parent pool slices."""
+        def hist_member(_, xs):
+            slot, start, cnt, vk = xs
+
+            def compute(_):
+                hidx = self._bucket_idx(jnp.maximum(cnt, 1))
+                return lax.switch(hidx, self._hist_branches, st.bins_p,
+                                  st.w_p, st.lid_p, start, cnt, slot)
+
+            def skip(_):
+                b = self.num_bins_padded
+                return jnp.zeros((self.f_pad, b, 3), self._hist_dtype())
+
+            return 0, lax.cond(vk, compute, skip, 0)
+
+        _, h_local = lax.scan(hist_member, 0,
+                              (sm_slot, sm_start, sm_cnt, valid))
+        # (W, f_pad, B, 3) -> (W, fs, B, 3): one collective per wave
+        h_small = lax.psum_scatter(h_local, self.axis, scatter_dimension=1,
+                                   tiled=True)
+        h_par = st.hist_pool[ph]                       # (W, fs, B, 3)
+        h_large = h_par - h_small
+        lsm = left_small[:, None, None, None]
+        hl = jnp.where(lsm, h_small, h_large)
+        hr = jnp.where(lsm, h_large, h_small)
+        pool = st.hist_pool.at[lh_w].set(hl).at[rh_w].set(hr)
+        return pool, hl, hr
+
+    def _hist_dtype(self):
+        import jax.numpy as jnp
+        return jnp.float64 if self.hist_dp else jnp.float32
+
+    # -- the sharded wave tree ----------------------------------------------
+
+    def _train_tree_wave_sharded(self, bins_p, grad, hess, bag, fmask_pad):
+        self._hist_branches = [self._make_hist_branch_shard(S)
+                               for S in self._win_sizes]
+        self._stall_branches = [
+            self._make_stall_branch(S, sort_mode=S > self._stall_cutoff)
+            for S in self._win_sizes]
+        st = self._init_root_wave(bins_p, grad, hess, bag, fmask_pad)
+
+        def gcond(s):
+            return (s.num_splits < self.grow_budget) & \
+                (jnp.max(self._pool_gains(s)) > 0.0)
+
+        st = lax.while_loop(gcond,
+                            lambda s: self._wave_body(s, fmask_pad), st)
+        return self._emit_tree_wave(st, fmask_pad)
+
+    def train_async(self, grad: jax.Array, hess: jax.Array, bag: jax.Array,
+                    feature_mask: Optional[jax.Array] = None):
+        if feature_mask is None:
+            feature_mask = jnp.ones(self.num_features, dtype=bool)
+        fmask_pad = jnp.zeros(self.f_pad, bool).at[:self.num_features].set(
+            feature_mask)
+        if self._jit_tree_w is None:
+            ax = self.axis
+            kw = dict(mesh=self.mesh,
+                      in_specs=(P(None, ax), P(ax), P(ax), P(ax), P()),
+                      out_specs=(P(), P(), P(), P(ax), P()))
+            try:
+                fn = shard_map(self._train_tree_wave_sharded,
+                               check_vma=False, **kw)
+            except TypeError:
+                fn = shard_map(self._train_tree_wave_sharded,
+                               check_rep=False, **kw)
+            self._jit_tree_w = jax.jit(fn)
+        return self._jit_tree_w(self.sharded_bins(), grad, hess, bag,
+                                fmask_pad)
+
+    def lowered_hlo_text(self) -> str:
+        n = self.n_pad
+        z = jnp.zeros(n, jnp.float32)
+        self.train_async(z, z, z)  # build the jit
+        fmask_pad = jnp.ones(self.f_pad, bool)
+        return self._jit_tree_w.lower(
+            self.sharded_bins(), z, z, z, fmask_pad).compile().as_text()
+
+
+def wave_sharded_eligible(cfg: Config, data: _ConstructedDataset,
+                          mesh_size: int) -> bool:
+    """The sharded wave learner reuses the serial wave shape/byte gates
+    with the PER-DEVICE shard length (no EFB condition — the sharded path
+    never bundles)."""
+    if cfg.tpu_learner not in ("auto", "wave"):
+        return False       # explicit compact/masked request is honored
+    if data.max_num_bin > 256:
+        return False
+    if data.num_data_padded % max(mesh_size, 1):
+        return False
+    if data.bins.shape[0] % max(mesh_size, 1):
+        return False
+    return wave_budget_reason(
+        cfg, int(data.num_data_padded) // max(mesh_size, 1),
+        data.bins.shape[0], int(data.max_num_bin)) is None

@@ -1,0 +1,102 @@
+"""Marginal cost per growth wave on the real TPU.
+
+Compiles the wave learner with the growth loop bounded to K waves
+(K = 0..max) and differences the timings: time(K) - time(K-1) is the full
+cost of wave K (sort + segment hists + child scans + bookkeeping) on the
+REAL state that wave sees.  Replay runs in every variant, so the replay
+cost sits in the K=0 base (plus whatever stall splits the truncated growth
+forces — the last column reports the pop/stall mix).
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+from jax import lax  # noqa: E402
+
+import lightgbm_tpu as lgb  # noqa: E402
+from lightgbm_tpu.learner_wave import WaveTPUTreeLearner  # noqa: E402
+
+
+def make(rows=1_000_000):
+    rng = np.random.RandomState(7)
+    f = 28
+    X = rng.randn(rows, f).astype(np.float64)
+    logit = (X[:, 0] * 1.5 + X[:, 1] * X[:, 2] * 0.5 + np.sin(X[:, 3])
+             + 0.5 * rng.randn(rows))
+    y = (logit > 0).astype(np.float64)
+    params = {"objective": "binary", "num_leaves": 255, "max_bin": 255,
+              "min_data_in_leaf": 20, "verbosity": -1, "metric": "none"}
+    ds = lgb.Dataset(X, label=y, params=params)
+    bst = lgb.Booster(params, ds)
+    gb = bst.gbdt
+    grad, hess = gb.objective.get_gradients(gb.train_score.score)
+    bag = jnp.ones(gb.learner.n_pad, jnp.float32)
+    return gb.learner, grad[0], hess[0], bag
+
+
+def timed(fn, args, iters=6):
+    out = fn(*args)
+    float(np.asarray(out[0][0, 0]))  # sync (block_until_ready is a no-op)
+    best = float("inf")
+    for _ in range(2):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn(*args)
+        float(np.asarray(out[0][0, 0]))
+        best = min(best, (time.perf_counter() - t0) / iters)
+    return best * 1e3
+
+
+def main():
+    rows = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
+    learner, grad, hess, bag = make(rows)
+    assert isinstance(learner, WaveTPUTreeLearner)
+    fm = jnp.ones(learner.num_features, dtype=bool)
+    bp = learner.bins_packed()
+
+    orig_body = WaveTPUTreeLearner._wave_body
+    prev = None
+    for K in (0, 1, 2, 3, 4, 5, 6, 7, 8, 9, 10):
+        def counted(self, st, feature_mask):
+            return orig_body(self, st, feature_mask)
+
+        def tree_k(bins_p, grad, hess, bag, feature_mask, K=K):
+            self = learner
+            self._hist_branches = [self._make_hist_branch(S)
+                                   for S in self._win_sizes]
+            self._stall_branches = [
+                self._make_stall_branch(S, sort_mode=S > self._stall_cutoff)
+                for S in self._win_sizes]
+            st = self._init_root_wave(bins_p, grad, hess, bag, feature_mask)
+
+            def gcond(c):
+                s, k = c
+                return (k < K) & (s.num_splits < self.budget) & \
+                    (jnp.max(self._pool_gains(s)) > 0.0)
+
+            st, _ = lax.while_loop(
+                gcond, lambda c: (self._wave_body(c[0], feature_mask),
+                                  c[1] + 1),
+                (st, jnp.asarray(0, jnp.int32)))
+            # growth only — replay is timed separately (full - growth)
+            return (st.cand_f, st.num_splits, st.num_splits)
+
+        fn = jax.jit(tree_k)
+        ms = timed(fn, (bp, grad, hess, bag, fm))
+        out = fn(bp, grad, hess, bag, fm)
+        pops = int(np.asarray(out[1]))
+        splits = int(np.asarray(out[2]))
+        d = "" if prev is None else f"  (+{ms - prev:6.1f})"
+        print(f"K={K:2d}  {ms:8.1f} ms{d}   splits={splits:3d} pops={pops:3d}")
+        prev = ms
+
+
+if __name__ == "__main__":
+    main()

@@ -7,6 +7,8 @@ import pytest
 from lightgbm_tpu.binning import (BIN_CATEGORICAL, MISSING_NAN, MISSING_NONE,
                                   MISSING_ZERO, BinMapper, greedy_find_bin)
 
+pytestmark = pytest.mark.fast
+
 
 def _fit(values, total=None, max_bin=255, min_data_in_bin=3, min_split=20,
          **kw):

@@ -16,6 +16,8 @@ from lightgbm_tpu.io.distributed import (LoopbackCluster, _feature_ranges,
                                          load_partitioned_file,
                                          partition_rows)
 
+pytestmark = pytest.mark.fast
+
 
 def _mapper_equal(a, b):
     """dict equality with NaN == NaN (the NaN bin's upper bound)."""

@@ -1,0 +1,117 @@
+"""Forced splits (`forcedsplits_filename`) vs the reference
+(`src/treelearner/serial_tree_learner.cpp:543-663` ``ForceSplits``).
+
+Golden numbers from the reference 2.2.4 CLI on
+`examples/binary_classification` with
+``forced_splits=examples/binary_classification/forced_splits.json
+num_trees=10 feature_fraction=1.0 bagging_freq=0`` (deterministic):
+
+    Iteration:5,  valid_1 auc 0.768737, binary_logloss 0.616573
+    Iteration:10, valid_1 auc 0.777356, binary_logloss 0.584556
+
+and the forced structure of every tree: root split on feature 25 at
+threshold 1.3075, both children on feature 26 at 0.8505.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+EXAMPLES = "/root/reference/examples/binary_classification"
+FORCED = EXAMPLES + "/forced_splits.json"
+
+GOLDEN = {
+    5: {"auc": 0.768737, "binary_logloss": 0.616573},
+    10: {"auc": 0.777356, "binary_logloss": 0.584556},
+}
+
+PARAMS = {"objective": "binary", "metric": "auc,binary_logloss",
+          "num_leaves": 63, "learning_rate": 0.1,
+          "min_data_in_leaf": 50, "min_sum_hessian_in_leaf": 5.0,
+          "max_bin": 255, "verbosity": -1, "gpu_use_dp": True,
+          "forcedsplits_filename": FORCED}
+
+needs_data = pytest.mark.skipif(not os.path.exists(EXAMPLES + "/binary.train"),
+                                reason="reference example data not available")
+
+
+def _first_splits(bst):
+    t = bst.dump_model()["tree_info"][0]["tree_structure"]
+    root = (t["split_feature"], round(float(t["threshold"]), 4))
+    left = (t["left_child"]["split_feature"],
+            round(float(t["left_child"]["threshold"]), 4))
+    right = (t["right_child"]["split_feature"],
+             round(float(t["right_child"]["threshold"]), 4))
+    return root, left, right
+
+
+@needs_data
+@pytest.mark.parametrize("learner", ["compact", "masked"])
+def test_forced_splits_match_reference(learner):
+    ds = lgb.Dataset(EXAMPLES + "/binary.train", params={"max_bin": 255})
+    dv = ds.create_valid(EXAMPLES + "/binary.test")
+    params = dict(PARAMS, tpu_learner=learner)
+    evals = {}
+    bst = lgb.train(params, ds, 10, valid_sets=[dv], valid_names=["valid_1"],
+                    evals_result=evals, verbose_eval=False)
+    root, left, right = _first_splits(bst)
+    assert root == (25, 1.3075)
+    assert left == (26, 0.8505)
+    assert right == (26, 0.8505)
+    for it, want in GOLDEN.items():
+        assert abs(evals["valid_1"]["auc"][it - 1] - want["auc"]) < 1e-6
+        assert abs(evals["valid_1"]["binary_logloss"][it - 1]
+                   - want["binary_logloss"]) < 1e-6
+
+
+@needs_data
+def test_wave_reroutes_to_compact_with_forced(capsys):
+    """tpu_learner=auto with forced splits uses the compact learner and
+    produces the identical model."""
+    ds = lgb.Dataset(EXAMPLES + "/binary.train", params={"max_bin": 255})
+    bst = lgb.train(dict(PARAMS), ds, 2)
+    root, left, right = _first_splits(bst)
+    assert root == (25, 1.3075) and left == right == (26, 0.8505)
+
+
+@needs_data
+def test_forced_abort_on_negative_gain(tmp_path):
+    """A forced split whose gain can't beat no-split aborts the remaining
+    forced queue (`serial_tree_learner.cpp:612-616`) and growth continues
+    normally: the model equals the unforced one."""
+    # threshold below the feature minimum puts everything on one side
+    bad = {"feature": 25, "threshold": -1000.0,
+           "left": {"feature": 26, "threshold": 0.85}}
+    p = tmp_path / "bad.json"
+    p.write_text(json.dumps(bad))
+    ds = lgb.Dataset(EXAMPLES + "/binary.train", params={"max_bin": 255})
+    forced = lgb.train(dict(PARAMS, forcedsplits_filename=str(p)), ds, 2)
+    ds2 = lgb.Dataset(EXAMPLES + "/binary.train", params={"max_bin": 255})
+    plain = lgb.train(dict(PARAMS, forcedsplits_filename=""), ds2, 2)
+    a = forced.dump_model()["tree_info"]
+    b = plain.dump_model()["tree_info"]
+    assert json.dumps(a) == json.dumps(b)
+
+
+def test_parse_forced_splits(tmp_path):
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.dataset import _ConstructedDataset
+    from lightgbm_tpu.forced import load_forced_splits
+    rng = np.random.RandomState(0)
+    X = rng.randn(500, 4)
+    cfg = Config.from_params({"max_bin": 63})
+    data = _ConstructedDataset.from_matrix(X, cfg)
+    spec = {"feature": 1, "threshold": 0.0,
+            "left": {"feature": 2, "threshold": 0.5},
+            "right": {"feature": 3, "threshold": -0.5,
+                      "left": {"feature": 0, "threshold": 0.1}}}
+    p = tmp_path / "fs.json"
+    p.write_text(json.dumps(spec))
+    out = load_forced_splits(str(p), data)
+    # BFS order with reference leaf numbering: split k's right child = k+1
+    assert [(f.leaf, f.feature_inner) for f in out] == \
+        [(0, 1), (0, 2), (1, 3), (1, 0)]

@@ -3,6 +3,8 @@
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.fast
+
 
 def _numpy_rows(lines, delim):
     if delim == " ":

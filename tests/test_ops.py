@@ -13,6 +13,8 @@ from lightgbm_tpu.ops.histogram import (build_histogram_onehot, fix_histogram,
                                         subtract_sibling)
 from lightgbm_tpu.ops.split import find_best_splits
 
+pytestmark = pytest.mark.fast
+
 
 def _np_hist(bins, w, num_bins):
     f, n = bins.shape

@@ -9,6 +9,8 @@ import jax.numpy as jnp
 from lightgbm_tpu.binning import MISSING_NONE, MISSING_NAN
 from lightgbm_tpu.ops.split_cat import find_best_splits_categorical
 
+pytestmark = pytest.mark.fast
+
 K_EPS = 1e-15
 
 

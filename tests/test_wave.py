@@ -238,3 +238,22 @@ def test_wave_exact_counts():
             lcnt = t.leaf_count[~l] if l < 0 else t.internal_count[l]
             rcnt = t.leaf_count[~r] if r < 0 else t.internal_count[r]
             assert lc[nd] == lcnt + rcnt
+
+
+def test_wave_chunked_rows_exact(monkeypatch):
+    """The lax.map'd per-row chunk path (large-N transient bound) is
+    bit-identical to the single-pass path."""
+    X, y = _make(n=8192, f=6)
+    pa, pb = _pair(num_leaves=15)
+    import lightgbm_tpu.learner_wave as lw
+    a = _train(pb, X, y)          # wave, single-pass (n < _row_chunk)
+    orig = lw.WaveTPUTreeLearner.__init__
+
+    def patched(self, *args, **kw):
+        orig(self, *args, **kw)
+        self._row_chunk = 1024    # force Cm > 1
+
+    monkeypatch.setattr(lw.WaveTPUTreeLearner, "__init__", patched)
+    b = _train(pb, X, y)
+    assert b.gbdt.learner._row_chunk == 1024
+    assert a.model_to_string() == b.model_to_string()
