@@ -738,8 +738,6 @@ def create_tree_learner(cfg: Config, data: _ConstructedDataset,
         from .learner_wave import WaveTPUTreeLearner, wave_ineligible_reason
         reason = wave_ineligible_reason(cfg, data)
         if reason is None:
-            if verbose >= 1 and explicit is False:
-                pass  # the default choice needs no announcement
             return WaveTPUTreeLearner(cfg, data, hist_backend)
         mode = "compact"
         if explicit:

@@ -422,7 +422,8 @@ class WaveTPUTreeLearner(CompactTPUTreeLearner):
                     jnp.rint(cnt3).astype(jnp.int32))
 
         Cm = 1
-        while n // Cm > self._row_chunk and Cm < 1024:
+        while n // Cm > self._row_chunk and Cm < 1024 \
+                and n % (Cm * 2) == 0:
             Cm *= 2
         bag_b = st.w_p[2] > 0.5
         if Cm == 1:
@@ -963,7 +964,8 @@ class WaveTPUTreeLearner(CompactTPUTreeLearner):
         # ~2^17 rows per step regardless of N (at 10.5M rows an unchunked
         # one-hot would be ~24 GB)
         Cl = 1
-        while self._rows_len() // Cl > (1 << 17) and Cl < 1024:
+        while self._rows_len() // Cl > (1 << 17) and Cl < 1024 \
+                and self._rows_len() % (Cl * 2) == 0:
             Cl *= 2
         if Cl == 1:
             leaf_ref = lookup_int(slot2ref, st.lid_p)

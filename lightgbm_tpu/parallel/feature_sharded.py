@@ -176,31 +176,9 @@ class FeatureShardedWaveLearner(FeatureShardedCompactLearner,
         return self._best_rows_global(hists, (sg, sh, cn), feature_mask,
                                       depth_ok, constraints)
 
-    def _wave_member_hists(self, st, sm_slot, sm_start, sm_cnt, valid, ph,
-                           lh_w, rh_w, left_small):
-        def hist_member(pool, xs):
-            slot, start, cnt, phk, lhk, rhk, lsm, vk = xs
-
-            def compute(pool):
-                hidx = self._bucket_idx(jnp.maximum(cnt, 1))
-                h_small = lax.switch(hidx, self._hist_branches, st.bins_p,
-                                     st.w_p, st.lid_p, start, cnt, slot)
-                h_par = pool[phk]
-                h_large = h_par - h_small
-                hl = jnp.where(lsm, h_small, h_large)
-                hr = jnp.where(lsm, h_large, h_small)
-                return pool.at[lhk].set(hl).at[rhk].set(hr), (hl, hr)
-
-            def skip(pool):
-                z = jnp.zeros_like(pool[0])
-                return pool, (z, z)
-
-            return lax.cond(vk, compute, skip, pool)
-
-        pool, (hl, hr) = lax.scan(
-            hist_member, st.hist_pool,
-            (sm_slot, sm_start, sm_cnt, ph, lh_w, rh_w, left_small, valid))
-        return pool, hl, hr
+    # _wave_member_hists: the inherited WaveTPUTreeLearner scan branch is
+    # already slice-local (sharded learners run with _use_pallas=False and
+    # the hist branches compute this device's feature slice) — no override
 
     def _train_tree_feature_wave(self, bins_p, grad, hess, bag, fmask_pad):
         self._hist_branches = [self._make_hist_branch_shard(S)
@@ -251,8 +229,11 @@ def feature_sharded_eligible(cfg: Config, data: _ConstructedDataset,
                              mesh_size: int) -> bool:
     if data.max_num_bin > 256:
         return False
-    # the word axis pads itself to a mesh multiple; only the base f_pad
-    # divisibility of the compact-sharded scaffolding must hold
+    # the word axis pads itself to a mesh multiple; the base
+    # compact-sharded scaffolding still asserts f_pad and n_pad
+    # divisibility in its __init__, so gate on both here
     if data.bins.shape[0] % max(mesh_size, 1):
+        return False
+    if data.num_data_padded % max(mesh_size, 1):
         return False
     return True

@@ -71,7 +71,8 @@ def main():
             return (st, jnp.zeros(M, bool).at[0].set(True),
                     jnp.zeros(M, jnp.int32), jnp.asarray(0, jnp.int32),
                     jnp.zeros(self.budget, jnp.int32),
-                    jnp.zeros(self.budget, jnp.int32))
+                    jnp.zeros(self.budget, jnp.int32),
+                    jnp.asarray(0, jnp.int32))
 
         WaveTPUTreeLearner._replay = fake_replay
     W = None

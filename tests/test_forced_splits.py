@@ -115,3 +115,12 @@ def test_parse_forced_splits(tmp_path):
     # BFS order with reference leaf numbering: split k's right child = k+1
     assert [(f.leaf, f.feature_inner) for f in out] == \
         [(0, 1), (0, 2), (1, 3), (1, 0)]
+
+
+@needs_data
+def test_forced_refused_in_parallel_modes():
+    """Parallel learners don't implement the forced phase yet — refuse
+    loudly instead of silently training a different model."""
+    ds = lgb.Dataset(EXAMPLES + "/binary.train", params={"max_bin": 255})
+    with pytest.raises(NotImplementedError, match="forcedsplits"):
+        lgb.train(dict(PARAMS, tree_learner="data"), ds, 1)
