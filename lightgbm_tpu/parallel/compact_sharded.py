@@ -414,8 +414,13 @@ class ShardedVotingLearner(ShardedCompactLearner):
     def __init__(self, cfg: Config, data: _ConstructedDataset, mesh: Mesh,
                  hist_backend: str = "auto"):
         super().__init__(cfg, data, mesh, hist_backend)
-        # 2k elected features, rounded to a mesh multiple for the scatter
-        # (f_pad is itself a mesh multiple, so min() preserves divisibility)
+        self._init_voting_sizing(cfg)
+
+    def _init_voting_sizing(self, cfg: Config) -> None:
+        """2k elected features, rounded to a mesh multiple for the scatter
+        (f_pad is itself a mesh multiple, so min() preserves divisibility).
+        Shared with the voting-wave learner — keep the rounding rules in
+        one place."""
         k2 = max(2 * int(cfg.top_k), self.D)
         k2 = min(((k2 + self.D - 1) // self.D) * self.D, self.f_pad)
         self.k_vote = min(int(cfg.top_k), self.f_pad)

@@ -64,7 +64,11 @@ def apply_parallel_sharding(gbdt, mesh: Mesh, mode: str) -> None:
         from .compact_sharded import (ShardedCompactLearner,
                                       ShardedVotingLearner)
         if mode == "voting":
-            cls = ShardedVotingLearner
+            from .wave_sharded import (ShardedVotingWaveLearner,
+                                       wave_sharded_eligible)
+            cls = ShardedVotingWaveLearner if wave_sharded_eligible(
+                learner.cfg, learner.data, mesh_size) \
+                else ShardedVotingLearner
         else:
             # data-parallel rides the frontier-wave learner where eligible
             # (the reference templates its parallel learners over its

@@ -156,11 +156,50 @@ class ShardedWaveLearner(ShardedCompactLearner, WaveTPUTreeLearner):
             self.sharded_bins(), z, z, z, fmask_pad).compile().as_text()
 
 
+class ShardedVotingWaveLearner(ShardedWaveLearner):
+    """``tree_learner=voting`` on the frontier-wave learner: the histogram
+    pool stays LOCAL-unreduced (exactly like the sequential
+    ``ShardedVotingLearner``) and every wave's 2W children each run the
+    PV-Tree election — local top-k votes, global top-2k election, elected
+    features' histograms reduce-scattered and scanned
+    (`voting_parallel_tree_learner.cpp:166-345`) — inside the one batched
+    candidate scan, so the election happens once per wave instead of once
+    per split."""
+
+    def __init__(self, cfg: Config, data: _ConstructedDataset, mesh: Mesh,
+                 hist_backend: str = "auto"):
+        super().__init__(cfg, data, mesh, hist_backend)
+        from .compact_sharded import ShardedVotingLearner
+        ShardedVotingLearner._init_voting_sizing(self, cfg)
+
+    def _reduce_hist(self, local_hist):
+        # the pool stays LOCAL; reduction happens per elected feature set
+        return local_hist
+
+    def _wave_member_hists(self, st, sm_slot, sm_start, sm_cnt, valid, ph,
+                           lh_w, rh_w, left_small):
+        # local full-width member histograms, NO exchange — subtraction
+        # against the local pool (the voting protocol reduces only the
+        # elected features inside the candidate scan)
+        return WaveTPUTreeLearner._wave_member_hists(
+            self, st, sm_slot, sm_start, sm_cnt, valid, ph, lh_w, rh_w,
+            left_small)
+
+    def _cand_rows_batch(self, hists, sg, sh, cn, feature_mask, depth_ok,
+                         constraints):
+        from .compact_sharded import ShardedVotingLearner
+        return ShardedVotingLearner._best_rows_global(
+            self, hists, (sg, sh, cn), feature_mask, depth_ok, constraints)
+
+
 def wave_sharded_eligible(cfg: Config, data: _ConstructedDataset,
                           mesh_size: int) -> bool:
     """The sharded wave learner reuses the serial wave shape/byte gates
     with the PER-DEVICE shard length (no EFB condition — the sharded path
-    never bundles)."""
+    never bundles).  NOTE: ``wave_budget_reason`` sizes the histogram pool
+    at the FULL feature width — exact for the voting learner's
+    local-unreduced pool, conservative for data-parallel's scattered one;
+    keep it that way if the formula is ever tightened."""
     if cfg.tpu_learner not in ("auto", "wave"):
         return False       # explicit compact/masked request is honored
     if data.max_num_bin > 256:

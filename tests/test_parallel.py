@@ -159,11 +159,12 @@ def test_voting_parallel_matches_data_parallel(rng):
     """With top_k covering all features the election is a no-op — voting
     must reproduce the data-parallel model; with a tight top_k it still
     trains a good model while communicating only elected histograms."""
-    from lightgbm_tpu.parallel.compact_sharded import ShardedVotingLearner
     X, y = _problem(rng, n=8192, f=12)
     dp = _train(X, y, "data")
     vp = _train(X, y, "voting")
-    assert isinstance(vp.gbdt.learner, ShardedVotingLearner)
+    # a voting learner (wave or sequential) must be routed
+    assert hasattr(vp.gbdt.learner, "k_vote"), \
+        type(vp.gbdt.learner).__name__
     np.testing.assert_allclose(dp.predict(X), vp.predict(X),
                                rtol=1e-4, atol=1e-5)
 
@@ -309,5 +310,49 @@ def test_feature_parallel_engine_uses_fast_learner(rng):
     assert isinstance(bst.gbdt.learner, FeatureShardedWaveLearner), \
         type(bst.gbdt.learner).__name__
     for _ in range(3):
+        bst.update()
+    assert bst.gbdt.models[-1].num_leaves > 2
+
+
+def test_voting_wave_records_match_sequential_voting(rng):
+    """The wave voting learner's per-child elections see the same local
+    histograms and sums as the sequential voting learner's — identical
+    records."""
+    import jax.numpy as jnp
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.parallel.compact_sharded import ShardedVotingLearner
+    from lightgbm_tpu.parallel.wave_sharded import ShardedVotingWaveLearner
+
+    X, y = _problem(rng, n=8192, f=12)
+    params = {"objective": "binary", "num_leaves": 31, "verbosity": -1,
+              "min_data_in_leaf": 20, "top_k": 5, "enable_bundle": False}
+    ds = lgb.Dataset(X, label=y, params=params)
+    ds.construct()
+    data = ds.constructed
+    cfg = Config.from_params(params)
+    n_pad = data.num_data_padded
+    grad = jnp.asarray(rng.randn(n_pad).astype(np.float32))
+    hess = jnp.ones(n_pad, jnp.float32) * 0.25
+    bag = jnp.zeros(n_pad, jnp.float32).at[:len(y)].set(1.0)
+
+    mesh = make_mesh(4)
+    seq = ShardedVotingLearner(cfg, data, mesh)
+    rf_s = np.asarray(seq.train_async(grad, hess, bag)[0])
+    wav = ShardedVotingWaveLearner(cfg, data, mesh)
+    rf_w = np.asarray(wav.train_async(grad, hess, bag)[0])
+    np.testing.assert_allclose(rf_w, rf_s, rtol=2e-4, atol=1e-4)
+
+
+def test_voting_engine_uses_wave(rng):
+    from lightgbm_tpu.parallel.wave_sharded import ShardedVotingWaveLearner
+
+    X, y = _problem(rng, n=4096, f=12)
+    params = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+              "min_data_in_leaf": 20, "tree_learner": "voting", "top_k": 5}
+    ds = lgb.Dataset(X, label=y, params=params)
+    bst = lgb.Booster(params, ds)
+    assert isinstance(bst.gbdt.learner, ShardedVotingWaveLearner), \
+        type(bst.gbdt.learner).__name__
+    for _ in range(2):
         bst.update()
     assert bst.gbdt.models[-1].num_leaves > 2
