@@ -137,7 +137,7 @@ def _hist_kernel_packed(bins_ref, w_ref, out_ref, *, num_bins_padded: int,
     # output axis and the bf16 terms stack along the channel axis, so each
     # word costs a single (3*nterms, Rb) x (Rb, 4*B) MXU contraction
     # instead of 4*nterms skinny ones — measured 6x on v5e
-    # (scratch/hist_kernel_variants.py)
+    # (profiling/profile_hist_variants.py)
     j = pl.program_id(1)
 
     @pl.when(j == 0)
