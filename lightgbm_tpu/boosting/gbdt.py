@@ -285,6 +285,7 @@ class GBDT:
         self._feat_rng = np.random.RandomState(cfg.feature_fraction_seed)
         self.loaded_parameter = ""
         self.average_output = False
+        self.pandas_categorical: Optional[list] = None
         self.eval_history: Dict[str, Dict[str, List[float]]] = {}
         if train_data is not None:
             self.init(train_data, objective)
@@ -387,6 +388,8 @@ class GBDT:
         self.max_feature_idx = data.num_total_features - 1
         self.feature_names = list(data.feature_names)
         self.feature_infos = _feature_infos(data)
+        self.pandas_categorical = getattr(train_data, "pandas_categorical",
+                                          None)
         self.class_need_train = [
             objective.class_need_train(k) if objective is not None else True
             for k in range(self.num_tree_per_iteration)]
@@ -869,6 +872,11 @@ class GBDT:
         body += "\nfeature importances:\n"
         for v, name in pairs:
             body += f"{name}={v}\n"
+        # pandas category mapping, the python layer's final model line
+        # (`basic.py:2233` _dump_pandas_categorical)
+        import json as _json
+        body += "\npandas_categorical:%s\n" % _json.dumps(
+            self.pandas_categorical, default=str)
         return body
 
     def save_model_to_file(self, filename: str, start_iteration: int = 0,
@@ -955,6 +963,14 @@ class GBDT:
 
     def load_model_from_string(self, s: str) -> "GBDT":
         """`gbdt_model_text.cpp:343-440`."""
+        for line in s.rsplit("\n", 3)[1:]:
+            if line.startswith("pandas_categorical:"):
+                import json as _json
+                try:
+                    self.pandas_categorical = _json.loads(
+                        line[len("pandas_categorical:"):])
+                except ValueError:
+                    self.pandas_categorical = None
         lines, trees_part = s.split("tree_sizes=", 1)
         header: Dict[str, str] = {}
         for line in lines.strip().split("\n"):

@@ -109,6 +109,26 @@ class Metadata:
         return out
 
 
+def recode_pandas(df, cat_cols, stored) -> np.ndarray:
+    """DataFrame → float64 matrix with ``category`` columns coded through
+    the ``stored`` category lists (positional pairing; values outside a
+    stored list → NaN).  Shared by training-time ``_data_from_pandas`` and
+    predict-time re-coding so the semantics cannot drift."""
+    cols = []
+    ci = 0
+    for j in range(df.shape[1]):
+        s = df.iloc[:, j]
+        if j in cat_cols:
+            s = s.cat.set_categories(stored[ci])
+            ci += 1
+            codes = s.cat.codes.to_numpy().astype(np.float64)
+            codes[codes < 0] = np.nan
+            cols.append(codes)
+        else:
+            cols.append(np.asarray(s, dtype=np.float64))
+    return np.column_stack(cols)
+
+
 class Dataset:
     """User-facing dataset (mirrors `python-package/lightgbm/basic.py:655-1575`
     ``Dataset`` semantics: lazy construction, reference-linked validation sets).
@@ -130,6 +150,11 @@ class Dataset:
         self.free_raw_data = free_raw_data
         self._constructed: Optional[_ConstructedDataset] = None
         self.used_indices: Optional[np.ndarray] = None
+        # category-dtype mapping recorded by `_data_from_pandas`
+        # (`basic.py:262-304`): list of per-column category lists, stored in
+        # the model so predict-time DataFrames re-apply the same code space
+        self.pandas_categorical: Optional[List[list]] = None
+        self._pandas_cat_cols: List[int] = []
 
     # -- lazy construction (basic.py:970 ``construct``) ---------------------
 
@@ -151,9 +176,13 @@ class Dataset:
                 if self._init_score is not None:
                     self._constructed.metadata.set_init_score(self._init_score)
                 return self
+            if self.reference is not None:
+                # construct the reference FIRST: _data_from_pandas needs its
+                # recorded category lists to code this frame consistently
+                self.reference.construct()
             data = self._load_raw(self._raw_data)
             if self.reference is not None:
-                ref = self.reference.construct()._constructed
+                ref = self.reference._constructed
                 self._constructed = _ConstructedDataset.from_reference(
                     data, ref, cfg)
             else:
@@ -186,9 +215,40 @@ class Dataset:
             return mat
         if hasattr(data, "toarray"):  # scipy sparse
             return np.asarray(data.toarray(), dtype=np.float64)
-        if hasattr(data, "values") and not isinstance(data, np.ndarray):  # pandas
+        if hasattr(data, "dtypes") and hasattr(data, "columns") \
+                and not isinstance(data, np.ndarray):  # pandas DataFrame
+            return self._data_from_pandas(data)
+        if hasattr(data, "values") and not isinstance(data, np.ndarray):
             return np.asarray(data.values, dtype=np.float64)
         return np.asarray(data, dtype=np.float64)
+
+    def _data_from_pandas(self, df) -> np.ndarray:
+        """DataFrame → float64 matrix with the reference's category-dtype
+        semantics (`python-package/lightgbm/basic.py:262-304`
+        ``_data_from_pandas``): ``category`` columns convert to their codes
+        (-1/unseen → NaN); the per-column category lists are recorded on
+        first use (training) or re-applied from the reference dataset
+        (valid sets), so the code space matches across datasets and
+        save/load."""
+        cat_cols = [j for j, c in enumerate(df.columns)
+                    if str(df.dtypes.iloc[j]) == "category"]
+        if not cat_cols:
+            return np.asarray(df.values, dtype=np.float64)
+        stored = None
+        ref = self.reference
+        if ref is not None:
+            stored = getattr(ref, "pandas_categorical", None)
+        if stored is None:
+            stored = [df.iloc[:, j].cat.categories.tolist()
+                      for j in cat_cols]
+        if len(stored) != len(cat_cols):
+            raise ValueError(
+                "train and valid dataset categorical_feature do not match "
+                f"({len(stored)} recorded category columns vs "
+                f"{len(cat_cols)} in this DataFrame)")
+        self.pandas_categorical = stored
+        self._pandas_cat_cols = list(cat_cols)
+        return recode_pandas(df, cat_cols, stored)
 
     def _resolve_feature_names(self, data) -> List[str]:
         if isinstance(self.feature_name, (list, tuple)):
@@ -201,8 +261,11 @@ class Dataset:
     def _resolve_categorical(self, data) -> List[int]:
         cf = self.categorical_feature
         if cf == "auto" or cf is None or cf == "":
-            # fall back to the config parameter (`categorical_feature=0,1,2`
-            # or `name:c1,c2` — `config.h:438-446` / `config.cpp` parsing)
+            # 'auto' = pandas category-dtype columns (`basic.py:262-304`),
+            # then the config parameter (`categorical_feature=0,1,2` or
+            # `name:c1,c2` — `config.h:438-446` / `config.cpp` parsing)
+            if self._pandas_cat_cols:
+                return sorted(self._pandas_cat_cols)
             cf = Config.from_params(self.params).categorical_feature
             if not cf:
                 return []

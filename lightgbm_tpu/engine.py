@@ -136,7 +136,10 @@ class Booster:
     def predict(self, data, num_iteration: int = -1, raw_score: bool = False,
                 pred_leaf: bool = False, pred_contrib: bool = False,
                 **kwargs) -> np.ndarray:
-        if hasattr(data, "values") and not isinstance(data, np.ndarray):
+        if hasattr(data, "dtypes") and hasattr(data, "columns") \
+                and not isinstance(data, np.ndarray):
+            data = self._predict_data_from_pandas(data)
+        elif hasattr(data, "values") and not isinstance(data, np.ndarray):
             data = data.values
         data = np.asarray(data, dtype=np.float64)
         if pred_contrib:
@@ -166,8 +169,25 @@ class Booster:
         ret = self.gbdt.dump_model(start_iteration, num_iteration)
         # the python layer appends pandas category mappings (`basic.py:2233`);
         # None for non-pandas-categorical training data
-        ret["pandas_categorical"] = None
+        ret["pandas_categorical"] = self.gbdt.pandas_categorical
         return ret
+
+    def _predict_data_from_pandas(self, df) -> np.ndarray:
+        """Predict-time DataFrame conversion: re-apply the category lists
+        recorded at training (`basic.py:262-304` — the stored order defines
+        the code space; unseen values → NaN)."""
+        stored = self.gbdt.pandas_categorical
+        cat_cols = [j for j, c in enumerate(df.columns)
+                    if str(df.dtypes.iloc[j]) == "category"]
+        if not cat_cols:
+            return np.asarray(df.values, dtype=np.float64)
+        if stored is None or len(stored) != len(cat_cols):
+            raise ValueError(
+                "train and predict dataset categorical_feature do not "
+                f"match ({0 if stored is None else len(stored)} recorded "
+                f"category columns vs {len(cat_cols)} in this DataFrame)")
+        from .dataset import recode_pandas
+        return recode_pandas(df, cat_cols, stored)
 
     def refit(self, data, label, decay_rate: float = 0.9,
               **kwargs) -> "Booster":

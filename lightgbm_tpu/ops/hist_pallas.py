@@ -35,6 +35,21 @@ def _round_up(x: int, m: int) -> int:
     return ((x + m - 1) // m) * m
 
 
+def _tile_params(fw: int, n: int, word_tile: int, row_block: int,
+                 num_bins: int):
+    """Shared Mosaic tiling normalization for the packed-word kernels:
+    word tile must divide fw and be 8-aligned (or the whole axis), the row
+    block must divide n and stay >= 128 lanes, bins pad to a lane multiple.
+    Returns (word_tile, rb, b_pad)."""
+    if fw % word_tile or (word_tile % 8 and word_tile != fw):
+        word_tile = 8 if fw % 8 == 0 else fw
+    rb = min(row_block, n)
+    while n % rb:
+        rb //= 2
+    assert rb >= 128, (n, row_block)
+    return word_tile, rb, _round_up(num_bins, 128)
+
+
 def _hist_kernel(bins_ref, w_ref, out_ref, *, num_bins_padded: int,
                  feature_tile: int):
     j = pl.program_id(1)
@@ -190,15 +205,8 @@ def build_histogram_packed(bins_words: jax.Array, w: jax.Array, *,
     Returns (Fw*4, num_bins, 3) f32.
     """
     fw, s = bins_words.shape
-    # Mosaic wants the block's leading dim divisible by 8 or equal to the
-    # full axis; pick the largest compliant word tile
-    if fw % word_tile or (word_tile % 8 and word_tile != fw):
-        word_tile = 8 if fw % 8 == 0 else fw
-    rb = min(row_block, s)
-    while s % rb:
-        rb //= 2
-    assert rb >= 128, (s, row_block)
-    b_pad = _round_up(num_bins, 128)
+    word_tile, rb, b_pad = _tile_params(fw, s, word_tile, row_block,
+                                        num_bins)
     grid = (fw // word_tile, s // rb)
     out = pl.pallas_call(
         functools.partial(_hist_kernel_packed, num_bins_padded=b_pad,
@@ -300,13 +308,8 @@ def build_histogram_segments(bins_words: jax.Array, w: jax.Array,
     Returns (n_slots, Fw*4, num_bins, 3) f32.
     """
     fw, n = bins_words.shape
-    if fw % word_tile or (word_tile % 8 and word_tile != fw):
-        word_tile = 8 if fw % 8 == 0 else fw
-    rb = min(row_block, n)
-    while n % rb:
-        rb //= 2
-    assert rb >= 128, (n, row_block)
-    b_pad = _round_up(num_bins, 128)
+    word_tile, rb, b_pad = _tile_params(fw, n, word_tile, row_block,
+                                        num_bins)
     grid = (fw // word_tile, chunk_slot.shape[0])
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
@@ -334,6 +337,111 @@ def build_histogram_segments(bins_words: jax.Array, w: jax.Array,
     # (S, fw, 3, 4, B) -> (S, fw*4, B, 3)
     out = out[:n_slots].reshape(n_slots, fw, 3, 4, b_pad) \
         .transpose(0, 1, 3, 4, 2).reshape(n_slots, fw * 4, b_pad, 3)
+    return out[:, :, :num_bins]
+
+
+# ---------------------------------------------------------------------------
+# Multi-slot full-pass kernel for the wave learner's LEVEL OPENING.
+#
+# The first tree levels run UNSORTED (rows stay in root order, only the
+# per-row leaf-id lane advances), so the segment kernel's chunk walk — which
+# needs each member's rows physically contiguous — cannot serve them.  This
+# kernel histograms K leaves in ONE pass over the full row axis: the bin
+# one-hot (the VPU-bound part, built once per packed word exactly as in
+# ``build_histogram_packed``) is SHARED across slots, and slot routing rides
+# the weight operand — a cheap (K, Rb) slot one-hot multiplied into the bf16
+# weight terms, so the MXU contraction per word becomes
+# ``(K·3·nterms, Rb) × (Rb, 4·B)``.  FLOPs scale with K, which keeps the
+# kernel MXU-cheap for the opening's K ≤ 16 members while the one-hot cost
+# stays that of a single pass.
+# ---------------------------------------------------------------------------
+
+
+def _hist_kernel_multislot(bins_ref, w_ref, slot_ref, out_ref, *,
+                           num_bins_padded: int, word_tile: int, nterms: int,
+                           n_slots: int):
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    w_blk = w_ref[...]          # (3, Rb) f32
+    slot_blk = slot_ref[...]    # (Rb,) int32; >= n_slots means masked
+    rb = w_blk.shape[1]
+    bp = num_bins_padded
+    iota_s = jax.lax.broadcasted_iota(jnp.int32, (n_slots, rb), 0)
+    soh = slot_blk[None, :] == iota_s                      # (K, Rb) bool
+    iota_b = jax.lax.broadcasted_iota(jnp.int32, (bp, rb), 0)
+    if nterms > 0:
+        wt = _expand_terms(w_blk, nterms)                  # (3T, Rb) bf16
+        a = (soh.astype(jnp.bfloat16)[:, None, :] * wt[None, :, :]) \
+            .reshape(n_slots * 3 * nterms, rb)
+        for wd in range(word_tile):
+            word = bins_ref[wd, :]
+            ohs = [(((word >> (8 * s)) & 0xFF)[None, :] == iota_b)
+                   .astype(jnp.bfloat16) for s in range(4)]
+            oh = jnp.concatenate(ohs, axis=0)              # (4B, Rb)
+            part = jax.lax.dot_general(
+                a, oh, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)        # (K*3T, 4B)
+            acc = part.reshape(n_slots, nterms, 3, 4 * bp).sum(axis=1)
+            out_ref[wd, :, :, :] += acc
+    else:  # full f32 emulation (tpu_hist_precision=highest)
+        a = (soh.astype(jnp.float32)[:, None, :] * w_blk[None, :, :]) \
+            .reshape(n_slots * 3, rb)
+        for wd in range(word_tile):
+            word = bins_ref[wd, :]
+            ohs = [(((word >> (8 * s)) & 0xFF)[None, :] == iota_b)
+                   .astype(jnp.float32) for s in range(4)]
+            oh = jnp.concatenate(ohs, axis=0)
+            part = jax.lax.dot_general(
+                a, oh, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+                precision=jax.lax.Precision.HIGHEST)
+            out_ref[wd, :, :, :] += part.reshape(n_slots, 3, 4 * bp)
+
+
+@functools.partial(jax.jit, static_argnames=("num_bins", "n_slots",
+                                             "word_tile", "row_block",
+                                             "nterms", "interpret"))
+def build_histogram_multislot(bins_words: jax.Array, w: jax.Array,
+                              slot: jax.Array, *, num_bins: int,
+                              n_slots: int, word_tile: int = 2,
+                              row_block: int = 2048, nterms: int = 2,
+                              interpret: bool = False) -> jax.Array:
+    """Per-slot histograms over the FULL row axis in one pass.
+
+    bins_words : (Fw, N) int32 packed codes; w (3, N) f32 (already masked
+                 by bag); slot (N,) int32 — output slot per row, any value
+                 outside [0, n_slots) contributes nowhere.
+    Returns (n_slots, Fw*4, num_bins, 3) f32.
+    """
+    fw, n = bins_words.shape
+    word_tile, rb, b_pad = _tile_params(fw, n, word_tile, row_block,
+                                        num_bins)
+    grid = (fw // word_tile, n // rb)
+    out = pl.pallas_call(
+        functools.partial(_hist_kernel_multislot, num_bins_padded=b_pad,
+                          word_tile=word_tile, nterms=nterms,
+                          n_slots=n_slots),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((word_tile, rb), lambda i, j: (i, j)),
+            pl.BlockSpec((3, rb), lambda i, j: (0, j)),
+            pl.BlockSpec((rb,), lambda i, j: (j,)),
+        ],
+        out_specs=pl.BlockSpec((word_tile, n_slots, 3, 4 * b_pad),
+                               lambda i, j: (i, 0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((fw, n_slots, 3, 4 * b_pad),
+                                       jnp.float32),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(bins_words, w, slot)
+    # (fw, K, 3, 4, B) -> (K, fw*4, B, 3)
+    out = out.reshape(fw, n_slots, 3, 4, b_pad) \
+        .transpose(1, 0, 3, 4, 2).reshape(n_slots, fw * 4, b_pad, 3)
     return out[:, :, :num_bins]
 
 

@@ -1,0 +1,56 @@
+"""Multi-slot opening kernel cost vs K on the real TPU (1M rows).
+
+Validates the cost model: the bin one-hot (shared across slots) is a fixed
+~2 ms floor; the MXU contraction scales with K.  Run:
+    python profiling/profile_multislot.py [rows]
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from lightgbm_tpu.ops.hist_pallas import (build_histogram_multislot,  # noqa: E402
+                                          build_histogram_packed,
+                                          pack_bin_words)
+
+
+def timed(fn, iters=8):
+    out = fn()
+    float(np.asarray(out).ravel()[0])
+    best = float("inf")
+    for _ in range(2):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn()
+        float(np.asarray(out).ravel()[0])
+        best = min(best, (time.perf_counter() - t0) / iters)
+    return best * 1e3
+
+
+def main():
+    rows = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
+    n = ((rows + 1023) // 1024) * 1024
+    f, b = 32, 256
+    rng = np.random.RandomState(5)
+    bins = rng.randint(0, b - 1, (f, n)).astype(np.uint8)
+    w = jnp.asarray(rng.randn(3, n).astype(np.float32))
+    bp = pack_bin_words(jnp.asarray(bins))
+
+    t = timed(lambda: build_histogram_packed(bp, w, num_bins=b, nterms=2))
+    print(f"packed single-pass        {t:7.2f} ms")
+    for k in (1, 2, 4, 8, 16, 32):
+        slot = jnp.asarray(rng.randint(0, k + 1, n).astype(np.int32))
+        t = timed(lambda: build_histogram_multislot(
+            bp, w, slot, num_bins=b, n_slots=k, nterms=2))
+        print(f"multislot K={k:<3d}           {t:7.2f} ms")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,137 @@
+"""pandas DataFrame integration: category-dtype round trip.
+
+Mirrors the reference python layer's ``_data_from_pandas``
+(`python-package/lightgbm/basic.py:262-304`): ``category`` columns train on
+their codes, the category lists persist in the model
+(``pandas_categorical``), and predict-time DataFrames are re-coded through
+the STORED lists — so a frame whose categories arrive in a different order
+(or with unseen values) still maps to the trained code space.
+"""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+pd = pytest.importorskip("pandas")
+
+
+def _frame(n=2000, seed=11, cats=("red", "green", "blue", "teal")):
+    rng = np.random.RandomState(seed)
+    c = rng.randint(0, len(cats), n)
+    x0 = rng.randn(n)
+    x1 = rng.randn(n)
+    y = (x0 + (c == 1) * 1.5 - (c == 3) * 2.0 + 0.1 * rng.randn(n) > 0)
+    df = pd.DataFrame({
+        "x0": x0,
+        "col": pd.Categorical([cats[i] for i in c], categories=cats),
+        "x1": x1,
+    })
+    return df, y.astype(float)
+
+
+PARAMS = {"objective": "binary", "num_leaves": 15, "max_bin": 63,
+          "min_data_in_leaf": 5, "verbosity": -1, "metric": "none",
+          "num_iterations": 10}
+
+
+def test_category_columns_train_and_dump():
+    df, y = _frame()
+    ds = lgb.Dataset(df, label=y, params=PARAMS)
+    bst = lgb.train(PARAMS, ds, num_boost_round=10, verbose_eval=False)
+    # the category column was picked up as categorical automatically
+    dumped = bst.dump_model()
+    assert dumped["pandas_categorical"] == [["red", "green", "blue", "teal"]]
+    assert any(t for t in dumped["tree_info"]
+               if any(d.get("decision_type") == "=="
+                      for d in _walk(t["tree_structure"])))
+    preds = bst.predict(df)
+    acc = np.mean((preds > 0.5) == (y > 0.5))
+    assert acc > 0.9
+
+
+def _walk(node):
+    out = [node]
+    for k in ("left_child", "right_child"):
+        if isinstance(node.get(k), dict):
+            out.extend(_walk(node[k]))
+    return out
+
+
+def test_predictions_survive_save_load_and_reordered_categories(tmp_path):
+    df, y = _frame()
+    ds = lgb.Dataset(df, label=y, params=PARAMS)
+    bst = lgb.train(PARAMS, ds, num_boost_round=10, verbose_eval=False)
+    ref = bst.predict(df)
+
+    path = str(tmp_path / "model.txt")
+    bst.save_model(path)
+    loaded = lgb.Booster(model_file=path)
+    assert loaded.gbdt.pandas_categorical == \
+        [["red", "green", "blue", "teal"]]
+    np.testing.assert_allclose(loaded.predict(df), ref, rtol=1e-6)
+
+    # same data, categories declared in a DIFFERENT order: codes differ,
+    # predictions must not (the stored list defines the code space)
+    df2 = df.copy()
+    df2["col"] = pd.Categorical(
+        df["col"].astype(str), categories=["teal", "blue", "green", "red"])
+    assert not np.array_equal(np.asarray(df["col"].cat.codes),
+                              np.asarray(df2["col"].cat.codes))
+    np.testing.assert_allclose(loaded.predict(df2), ref, rtol=1e-6)
+
+
+def test_unseen_category_predicts_as_missing():
+    df, y = _frame()
+    ds = lgb.Dataset(df, label=y, params=PARAMS)
+    bst = lgb.train(PARAMS, ds, num_boost_round=10, verbose_eval=False)
+    df2 = df.copy()
+    df2["col"] = pd.Categorical(["violet"] * len(df))  # never trained
+    dfnan = df.copy()
+    dfnan["col"] = pd.Categorical([None] * len(df),
+                                  categories=["red", "green", "blue", "teal"])
+    np.testing.assert_allclose(bst.predict(df2), bst.predict(dfnan))
+
+
+def test_valid_set_uses_train_code_space():
+    df, y = _frame()
+    # valid frame declares only the categories it contains, in another order
+    dfv = df.iloc[:500].copy()
+    dfv["col"] = pd.Categorical(dfv["col"].astype(str),
+                                categories=["blue", "red", "green", "teal"])
+    ds = lgb.Dataset(df, label=y, params=PARAMS)
+    dsv = lgb.Dataset(dfv, label=y[:500], reference=ds, params=PARAMS)
+    res = {}
+    p = dict(PARAMS, metric="binary_logloss")
+    bst = lgb.train(p, ds, num_boost_round=5, valid_sets=[dsv],
+                    evals_result=res, verbose_eval=False)
+    # the valid set re-coded through the train mapping: its logloss matches
+    # a direct evaluation of the predictions
+    preds = bst.predict(dfv)
+    eps = 1e-15
+    ll = -np.mean(y[:500] * np.log(preds + eps)
+                  + (1 - y[:500]) * np.log(1 - preds + eps))
+    assert abs(res["valid_0"]["binary_logloss"][-1] - ll) < 1e-3
+
+
+def test_valid_constructed_before_reference_uses_train_code_space():
+    # constructing the valid set FIRST must still code through the train
+    # mapping (construct() builds the reference before loading raw data)
+    df, y = _frame()
+    dfv = df.iloc[:500].copy()
+    dfv["col"] = pd.Categorical(dfv["col"].astype(str),
+                                categories=["teal", "blue", "green", "red"])
+    ds = lgb.Dataset(df, label=y, params=PARAMS)
+    dsv = lgb.Dataset(dfv, label=y[:500], reference=ds, params=PARAMS)
+    dsv.construct()          # before ds.construct()
+    assert dsv.pandas_categorical == [["red", "green", "blue", "teal"]]
+
+
+def test_mismatched_category_columns_raise():
+    df, y = _frame()
+    ds = lgb.Dataset(df, label=y, params=PARAMS)
+    bst = lgb.train(PARAMS, ds, num_boost_round=5, verbose_eval=False)
+    df2 = df.copy()
+    df2["x1"] = pd.Categorical(["a"] * len(df))  # extra category column
+    with pytest.raises(ValueError, match="do not match"):
+        bst.predict(df2)

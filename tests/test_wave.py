@@ -225,6 +225,35 @@ def test_segment_hist_kernel_interpret():
                                            rtol=1e-5, atol=1e-4)
 
 
+def test_multislot_hist_kernel_interpret():
+    # the opening-phase full-pass kernel (K leaves in one pass, slot routing
+    # in the weight operand) vs a bincount oracle, Pallas interpret mode
+    import jax.numpy as jnp
+    from lightgbm_tpu.ops.hist_pallas import (build_histogram_multislot,
+                                              pack_bin_words)
+
+    rng = np.random.RandomState(37)
+    n, f, b, K = 4096, 8, 64, 4
+    bins = rng.randint(0, b, (f, n)).astype(np.uint8)
+    w = rng.randn(3, n).astype(np.float32)
+    # interleaved slots incl. masked rows (slot == K) — root-order layout
+    slot = rng.randint(0, K + 1, n).astype(np.int32)
+    for nterms in (0, 3):
+        out = np.asarray(build_histogram_multislot(
+            pack_bin_words(jnp.asarray(bins)), jnp.asarray(w),
+            jnp.asarray(slot), num_bins=b, n_slots=K, row_block=512,
+            nterms=nterms, interpret=True))
+        assert out.shape == (K, f, b, 3)
+        for k in range(K):
+            m = (slot == k).astype(np.float64)
+            for fi in range(f):
+                for ch in range(3):
+                    ref = np.bincount(bins[fi], weights=w[ch] * m,
+                                      minlength=b)[:b]
+                    np.testing.assert_allclose(out[k, fi, :, ch], ref,
+                                               rtol=1e-5, atol=1e-3)
+
+
 def test_wave_exact_counts():
     X, y = _make(15000)
     _, pb = _pair(bagging_fraction=0.5, bagging_freq=1, seed=9)
