@@ -345,6 +345,21 @@ class Config:
     # the sort entirely — the sort is the wave learner's top cost and the
     # tree's bottom waves are all small windows
     tpu_wave_sort_cutoff: int = 8192
+    # level-wise OPENING: the first L tree levels grow with NO row sorting
+    # (rows stay in root order; one multi-slot full-pass histogram kernel
+    # serves each level), then a single materialization sort compacts all
+    # windows at once.  MEASURED A NET LOSS on v5e (the full-array pass
+    # floors at the one-hot cost regardless of member count — see
+    # learner_wave.py and profiling/PROFILE.md), so -1 = auto = DISABLED;
+    # set an explicit L > 0 to force it (exactness tests do)
+    tpu_wave_open_levels: int = -1
+    # defer the wave re-compaction sort on alternating waves: a deferring
+    # wave assigns logical child windows + sort keys only (member
+    # histograms scan the member's materialized span with lid masks, ~2x
+    # the child window area); the next wave's single sort materializes
+    # both levels.  Halves the number of full-array sorts — the wave
+    # learner's largest per-wave cost (~6 ms each on v5e at 1M rows)
+    tpu_wave_defer_sorts: bool = True
 
     # derived (not user-settable)
     is_parallel: bool = field(default=False, repr=False)

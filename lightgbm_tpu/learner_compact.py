@@ -447,7 +447,12 @@ class CompactTPUTreeLearner(TPUTreeLearner):
             crow_f = state.cand_f[best_leaf]      # (NUM_CF,) acc
             crow_i = state.cand_i[best_leaf]      # (NUM_CI,) int32
             crow_b = state.cand_b[best_leaf]      # (W,) uint32
-            do = crow_f[CF_GAIN] > 0.0
+            # the leaf-budget guard matters for fixed-trip callers (the
+            # sharded fori_loop runs L-1 iterations regardless of how many
+            # forced splits preceded); the serial while_loop's condition
+            # makes it redundant there
+            do = (crow_f[CF_GAIN] > 0.0) & \
+                (state.num_leaves < self.num_leaves)
         else:
             best_leaf, crow_f, crow_i, crow_b, do = forced
             best_leaf = jnp.asarray(best_leaf, jnp.int32)
@@ -580,6 +585,21 @@ class CompactTPUTreeLearner(TPUTreeLearner):
         self._forced = list(forced) if forced else None
         self._jit_tree_c = jax.jit(self._train_tree_compact)
 
+    def _forced_hrow(self, state: CompactState, fs, sum_g, sum_h, cnt):
+        """FIXED (B, 3) histogram row of the forced feature at the target
+        leaf.  Seam for the sharded learners, whose pools hold feature
+        SLICES (data/feature-parallel) or local-unreduced histograms
+        (voting) — they fetch/reduce the one row and fix it alone."""
+        hist = state.hist_pool[fs.leaf]
+        if self._bundle is not None:
+            hist = self._unbundle_hist(hist, sum_g, sum_h, cnt)
+        # the reference FixHistograms before GatherInfoForThreshold
+        # (`serial_tree_learner.cpp:486` runs inside the ForceSplits loop's
+        # FindBestSplits) — forced chains must see the same default-bin
+        # reconstruction the scans do
+        hist = self._fix_histogram(hist, sum_g, sum_h, cnt)
+        return hist[fs.feature_inner]                      # (B, 3), static f
+
     def _forced_candidate_compact(self, state: CompactState, fs):
         """Candidate rows for one forced split from the target leaf's
         pooled histogram (GatherInfoForThreshold semantics)."""
@@ -588,15 +608,7 @@ class CompactTPUTreeLearner(TPUTreeLearner):
         leaf = fs.leaf
         lrow = state.leaf_f[leaf]
         sum_g, sum_h, cnt = lrow[LF_SUM_G], lrow[LF_SUM_H], lrow[LF_CNT]
-        hist = state.hist_pool[leaf]
-        if self._bundle is not None:
-            hist = self._unbundle_hist(hist, sum_g, sum_h, cnt)
-        # the reference FixHistograms before GatherInfoForThreshold
-        # (`serial_tree_learner.cpp:486` runs inside the ForceSplits loop's
-        # FindBestSplits) — forced chains must see the same default-bin
-        # reconstruction the scans do
-        hist = self._fix_histogram(hist, sum_g, sum_h, cnt)
-        hrow = hist[fs.feature_inner]                      # (B, 3), static f
+        hrow = self._forced_hrow(state, fs, sum_g, sum_h, cnt)
         gain, lg, lh, lc, rg, rh, rc, lo, ro, valid = forced_split_info(
             hrow, sum_g, sum_h, cnt,
             threshold=fs.threshold_bin,

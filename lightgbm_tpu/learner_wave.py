@@ -70,7 +70,11 @@ class WaveState(NamedTuple):
     lid_p: jax.Array      # (N,) int32 node-slot ids
     key_p: jax.Array      # (N,) int32 window-order sort keys (2*start+bit)
     # per-node-slot state (M slots; a split allocates 2 fresh child slots)
-    node_i: jax.Array     # (M, 2) int32 window [start, width]
+    node_i: jax.Array     # (M, 2) int32 LOGICAL window [start, width]
+    phys_i: jax.Array     # (M, 2) int32 materialized covering span (equals
+    #                       node_i except for children created on a
+    #                       sort-DEFERRING wave, whose rows still live in
+    #                       the parent's span until the next sort)
     node_f: jax.Array     # (M, NUM_LF) acc sums/cnt/out/depth/bounds
     cand_f: jax.Array     # (M, NUM_CF) acc best-split floats
     cand_i: jax.Array     # (M, NUM_CI) int32 feature/threshold/flags
@@ -83,6 +87,7 @@ class WaveState(NamedTuple):
     hist_pool: jax.Array  # (H, F, B, 3)
     num_nodes: jax.Array  # () int32
     num_splits: jax.Array  # () int32
+    pending: jax.Array    # () bool — keys assigned but not yet sorted
 
 
 class WaveTPUTreeLearner(CompactTPUTreeLearner):
@@ -132,6 +137,20 @@ class WaveTPUTreeLearner(CompactTPUTreeLearner):
             self.budget + int(np.ceil(self.budget
                                       * float(cfg.tpu_wave_overshoot))),
             2 * self.budget)
+        # level-wise opening depth (see Config.tpu_wave_open_levels).
+        # MEASURED on the v5e (round 5, profiling/profile_opening.py + a
+        # device trace): a full-array multi-slot hist pass floors at ~6 ms
+        # of one-hot VPU work regardless of K, so an opening level costs
+        # ~8-18 ms against the ~10.6 ms wave it replaces, plus a ~6 ms
+        # materialization sort — a NET LOSS at every depth on the bench
+        # workload.  Auto therefore DISABLES the opening; the knob remains
+        # for exactness tests and future kernels that beat the floor.
+        ol = int(getattr(cfg, "tpu_wave_open_levels", -1))
+        if ol < 0:
+            ol = 0
+        self.open_levels = max(0, min(ol, (self.budget + 1).bit_length() - 1))
+        # sort-deferral alternation (Config.tpu_wave_defer_sorts)
+        self._defer_sorts = bool(getattr(cfg, "tpu_wave_defer_sorts", True))
         self.M = 1 + 2 * (self.grow_budget + self.budget)
         self.H = self.grow_budget + self.budget + 2
         # row-chunk bound for the per-row mask contractions: bounds the
@@ -205,6 +224,7 @@ class WaveTPUTreeLearner(CompactTPUTreeLearner):
             lid_p=lid0,
             key_p=jnp.zeros(n, jnp.int32),
             node_i=jnp.zeros((M, 2), jnp.int32).at[0, 1].set(n),
+            phys_i=jnp.zeros((M, 2), jnp.int32).at[0, 1].set(n),
             node_f=jnp.zeros((M, NUM_LF), acc)
                       .at[:, LF_MIN_C].set(-jnp.inf)
                       .at[:, LF_MAX_C].set(jnp.inf)
@@ -222,7 +242,8 @@ class WaveTPUTreeLearner(CompactTPUTreeLearner):
             hist_pool=jnp.zeros((H,) + root_hist.shape, root_hist.dtype)
                          .at[0].set(root_hist),
             num_nodes=jnp.asarray(1, jnp.int32),
-            num_splits=jnp.asarray(0, jnp.int32))
+            num_splits=jnp.asarray(0, jnp.int32),
+            pending=jnp.asarray(False))
 
     # -- one growth wave ------------------------------------------------------
 
@@ -231,9 +252,15 @@ class WaveTPUTreeLearner(CompactTPUTreeLearner):
         return jnp.where(alive, st.cand_f[:, CF_GAIN], -jnp.inf)
 
     def _children_bookkeeping(self, st, wi, valid, lslot, rslot, lc_bag,
-                              c_bag, li, ri, lh, rh, hists2, feature_mask):
+                              c_bag, li, ri, lh, rh, hists2, feature_mask,
+                              phys_l=None, phys_r=None):
         """Shared by the wave body (K=W) and the stall split (K=1): writes
-        all per-child node state given the children's histograms."""
+        all per-child node state given the children's histograms.
+        ``phys_l/phys_r`` are the children's materialized covering spans
+        (default: the logical windows — correct whenever the caller's rows
+        are physically compacted, as in the stall split)."""
+        if phys_l is None:
+            phys_l, phys_r = li, ri
         acc = self._acc
         K = wi.shape[0]
         pcf = st.cand_f[wi]                       # (K, NUM_CF)
@@ -294,6 +321,7 @@ class WaveTPUTreeLearner(CompactTPUTreeLearner):
         s2 = i2(ls_w, rs_w)
         st = st._replace(
             node_i=st.node_i.at[ls_w].set(li).at[rs_w].set(ri),
+            phys_i=st.phys_i.at[ls_w].set(phys_l).at[rs_w].set(phys_r),
             node_f=st.node_f.at[s2].set(lf2),
             cand_f=st.cand_f.at[s2].set(cf2),
             cand_i=st.cand_i.at[s2].set(ci2),
@@ -310,8 +338,18 @@ class WaveTPUTreeLearner(CompactTPUTreeLearner):
             + jnp.sum(valid, dtype=jnp.int32).astype(jnp.int32))
         return st
 
-    def _wave_body(self, st: WaveState, feature_mask) -> WaveState:
-        W, M, n = self.W, self.M, self._rows_len()
+    def _wave_body(self, st: WaveState, feature_mask, width: int = 0,
+                   opening: bool = False) -> WaveState:
+        """One growth wave.  ``width`` overrides the member cap (0 = the
+        configured W).  ``opening=True`` runs the wave in LEVEL-OPENING
+        mode: no sort executes — every valid member's children get distinct
+        LOGICAL windows and their rows get the matching sort keys, so a
+        single later materialization sort (``_materialize_sort``) compacts
+        all opening levels at once; member histograms run as full-array
+        lid-masked passes (``_opening_hists``) since no window is
+        physically contiguous yet."""
+        W = width or self.W
+        M, n = self.M, self._rows_len()
         fw = self.fw
         # ---- select the wave: top-W positive-gain frontier leaves
         g = self._pool_gains(st)
@@ -338,9 +376,14 @@ class WaveTPUTreeLearner(CompactTPUTreeLearner):
         boff = self.fw_goff[feat]
         bnd = self.fw_bnd[feat]
         # members at or below the wave cutoff split in place (lid rewrite,
-        # children share the parent span); only sortable members join the
-        # global sort
-        sortable = valid & (cw > self._wave_cutoff)
+        # children share the parent span); only keyed members' rows get new
+        # window keys.  Opening mode keys EVERY valid member (children get
+        # logical windows now, physical compaction happens at the deferred
+        # materialization sort); normal mode keys the members it sorts
+        if opening:
+            sortable = valid
+        else:
+            sortable = valid & (cw > self._wave_cutoff)
         P = jnp.stack([widx.astype(jnp.float32), shift.astype(jnp.float32),
                        thr.astype(jnp.float32), dleft, iscat,
                        mt.astype(jnp.float32), db.astype(jnp.float32),
@@ -474,10 +517,22 @@ class WaveTPUTreeLearner(CompactTPUTreeLearner):
                 (st.lid_p.reshape(Cm, ch), go_left.reshape(Cm, ch),
                  sort_r.reshape(Cm, ch),
                  st.key_p.reshape(Cm, ch))).reshape(-1)
-        # ---- ONE stable sort re-compacts every sortable split window
-        # (skipped when the whole wave froze — the tree's bottom waves)
+        # ---- ONE stable sort re-compacts every sortable split window.
+        # Skipped when the whole wave froze (the tree's bottom waves), when
+        # opening mode defers ALL compaction to the materialization sort,
+        # and — under sort-deferral alternation — on every wave without a
+        # PENDING key set: a deferring wave only assigns logical windows +
+        # keys, and the NEXT wave's single sort materializes both levels.
         do_sort = jnp.any(sortable)
-        if "nosort" not in self._ablate:
+        if opening:
+            st = st._replace(lid_p=lid_p, key_p=key_p)
+            sorted_now = jnp.asarray(False)
+        elif "nosort" not in self._ablate:
+            if self._defer_sorts:
+                sort_now = st.pending
+            else:
+                sort_now = do_sort
+
             def run_sort(args):
                 key_p, bins_p, w_p, rid_p, lid_p = args
                 ops = ([key_p] + [bins_p[i] for i in range(fw)]
@@ -487,36 +542,66 @@ class WaveTPUTreeLearner(CompactTPUTreeLearner):
                         jnp.stack(sd[1 + fw:4 + fw]), sd[4 + fw], sd[5 + fw])
 
             key_p, bins_p, w_p, rid_p, lid_p = lax.cond(
-                do_sort, run_sort, lambda a: a,
+                sort_now, run_sort, lambda a: a,
                 (key_p, st.bins_p, st.w_p, st.rid_p, lid_p))
             st = st._replace(bins_p=bins_p, w_p=w_p, rid_p=rid_p,
                              lid_p=lid_p, key_p=key_p)
+            sorted_now = sort_now
         else:  # profiling skeleton: windows stay unsorted (garbage layout)
             st = st._replace(lid_p=lid_p, key_p=key_p)
+            sorted_now = do_sort
+        st = st._replace(pending=(st.pending | do_sort) & ~sorted_now)
         # ---- child windows: sortable members split [s,lc)/[s+lc,..);
         # frozen members' children share the parent span
         li = jnp.stack([ps, jnp.where(sortable, lc_w, cw)], 1)
         ri2 = jnp.stack([jnp.where(sortable, ps + lc_w, ps),
                          jnp.where(sortable, cw - lc_w, cw)], 1)
-        # ---- smaller-child histograms (+ sibling subtraction) per member
+        # children's materialized covering spans: the logical windows when
+        # this wave sorted (everything compacts), the MEMBER's span when
+        # the sort was deferred (rows haven't moved)
+        mphys = st.phys_i[wi]                                   # (W, 2)
+        phys_l = jnp.where(sorted_now, li, mphys)
+        phys_r = jnp.where(sorted_now, ri2, mphys)
+        # ---- smaller-child histograms (+ sibling subtraction) per member.
+        # Post-sort, every member's window is materialized — scan the
+        # logical child window (or the shared node span for frozen
+        # members); on a deferring wave scan the member's covering span
+        # with the lid mask doing the selection
         left_small = lc_bag <= (c_bag - lc_bag)
         sm_slot = jnp.where(left_small, lslot, rslot)
-        sm_start = jnp.where(sortable & ~left_small, ps + lc_w, ps)
-        sm_cnt = jnp.where(sortable,
-                           jnp.where(left_small, lc_w, cw - lc_w), cw)
+        sm_start = jnp.where(sorted_now,
+                             jnp.where(sortable & ~left_small, ps + lc_w,
+                                       ps),
+                             mphys[:, 0])
+        sm_cnt = jnp.where(sorted_now,
+                           jnp.where(sortable,
+                                     jnp.where(left_small, lc_w,
+                                               cw - lc_w), cw),
+                           mphys[:, 1])
         ph = st.hslot[wi]
         rh = 1 + st.num_splits + pos
         oobh = jnp.int32(self.H + 7)
         lh_w = jnp.where(valid, ph, oobh)
         rh_w = jnp.where(valid, rh, oobh)
 
-        pool, hl, hr = self._wave_member_hists(
-            st, sm_slot, sm_start, sm_cnt, valid, ph, lh_w, rh_w, left_small)
+        if opening:
+            # sm_start/sm_cnt reference LOGICAL windows (nothing has been
+            # compacted yet) — opening hists mask by lid over the full array
+            pool, hl, hr = self._opening_hists(
+                st, sm_slot, valid, ph, lh_w, rh_w, left_small)
+        else:
+            pool, hl, hr = self._wave_member_hists(
+                st, sm_slot, sm_start, sm_cnt, valid, ph, lh_w, rh_w,
+                left_small)
         st = st._replace(hist_pool=pool)
-        hists2 = jnp.stack([hl, hr], 1).reshape((2 * self.W,) + hl.shape[1:])
-        return self._children_bookkeeping(
+        hists2 = jnp.stack([hl, hr], 1).reshape((2 * W,) + hl.shape[1:])
+        st = self._children_bookkeeping(
             st, wi, valid, lslot, rslot, lc_bag, c_bag, li, ri2, ph, rh,
-            hists2, feature_mask)
+            hists2, feature_mask, phys_l, phys_r)
+        # a sort materializes EVERY node (stale covering spans from the
+        # previous deferring wave included), not just this wave's children
+        return st._replace(phys_i=jnp.where(sorted_now, st.node_i,
+                                            st.phys_i))
 
     def _wave_member_hists(self, st: WaveState, sm_slot, sm_start, sm_cnt,
                            valid, ph, lh_w, rh_w, left_small):
@@ -525,7 +610,7 @@ class WaveTPUTreeLearner(CompactTPUTreeLearner):
         subclass overrides this to reduce-scatter the W local histograms
         over the feature axis before subtraction."""
         if "nohist" in self._ablate:
-            shp = (self.W, self._hist_cols, self._hist_nbins, 3)
+            shp = (sm_slot.shape[0], self._hist_cols, self._hist_nbins, 3)
             hl = hr = jnp.zeros(shp, st.hist_pool.dtype)
             return st.hist_pool, hl, hr
         if self._use_pallas:
@@ -567,6 +652,55 @@ class WaveTPUTreeLearner(CompactTPUTreeLearner):
              valid))
         return pool, hl, hr
 
+    def _opening_hists(self, st: WaveState, sm_slot, valid, ph, lh_w, rh_w,
+                       left_small):
+        """Smaller-child histograms for one OPENING level: rows are still
+        in root order (no sort has run), so the segment kernel's chunk walk
+        cannot apply.  Serial TPU: ONE multi-slot full pass
+        (`ops/hist_pallas.py:build_histogram_multislot`) — the bin one-hot
+        is built once and shared across the K members.  Fallback (CPU /
+        f64 / sharded subclasses): per-member full-span lid-masked scans
+        through the regular member-hist seam, which keeps the sharded
+        psum_scatter exchange intact."""
+        if self._use_pallas and type(self)._wave_member_hists is \
+                WaveTPUTreeLearner._wave_member_hists:
+            from .ops.hist_pallas import build_histogram_multislot
+            K = sm_slot.shape[0]
+            sl = jnp.where(valid, sm_slot, -1)
+            slot_r = jnp.full(st.lid_p.shape, K, jnp.int32)
+            for k in range(K):
+                slot_r = jnp.where(st.lid_p == sl[k], k, slot_r)
+            h_small = build_histogram_multislot(
+                st.bins_p, st.w_p, slot_r, num_bins=self._hist_nbins,
+                n_slots=K, row_block=self._seg_rb,
+                nterms=self._hist_nterms)[:, :self._hist_cols]
+            h_par = st.hist_pool[ph]
+            h_large = h_par - h_small
+            lsm = left_small[:, None, None, None]
+            hl = jnp.where(lsm, h_small, h_large)
+            hr = jnp.where(lsm, h_large, h_small)
+            pool = st.hist_pool.at[lh_w].set(hl).at[rh_w].set(hr)
+            return pool, hl, hr
+        n = self._rows_len()
+        return self._wave_member_hists(
+            st, sm_slot, jnp.zeros_like(sm_slot),
+            jnp.full_like(sm_slot, n), valid, ph, lh_w, rh_w, left_small)
+
+    def _materialize_sort(self, st: WaveState) -> WaveState:
+        """One stable full-array sort on the window keys assigned by the
+        opening levels: every leaf's rows land contiguously at its logical
+        window (keys are 2×(window start), strictly increasing with
+        position — the invariant the per-wave sorts maintain), after which
+        the regular wave flow's physical-window machinery applies."""
+        fw = self.fw
+        ops = ([st.key_p] + [st.bins_p[i] for i in range(fw)]
+               + [st.w_p[0], st.w_p[1], st.w_p[2], st.rid_p, st.lid_p])
+        sd = lax.sort(ops, num_keys=1, is_stable=True)
+        return st._replace(key_p=sd[0], bins_p=jnp.stack(sd[1:1 + fw]),
+                           w_p=jnp.stack(sd[1 + fw:4 + fw]),
+                           rid_p=sd[4 + fw], lid_p=sd[5 + fw],
+                           phys_i=st.node_i, pending=jnp.asarray(False))
+
     def _segment_hists(self, st: WaveState, sm_slot, sm_start, sm_cnt,
                        valid):
         """Smaller-child histograms for every wave member in ONE Pallas
@@ -575,7 +709,7 @@ class WaveTPUTreeLearner(CompactTPUTreeLearner):
         block alignment never matters.  Invalid members get one all-masked
         chunk so their output slot is defined (zeros)."""
         from .ops.hist_pallas import build_histogram_segments
-        W = self.W
+        W = sm_slot.shape[0]        # wave width (narrow on ramp waves)
         rb = self._seg_rb
         # sortable smaller-child windows are disjoint (<= n_pad rows total);
         # frozen members scan their shared parent span (<= wave cutoff each)
@@ -619,6 +753,23 @@ class WaveTPUTreeLearner(CompactTPUTreeLearner):
         out = lax.switch(idx, [make_branch(t) for t in Ts], slot_t, block_t,
                          leaf_t, st.bins_p, st.w_p, st.lid_p)
         return out[:, :self._hist_cols]
+
+    def _wave_step(self, st: WaveState, feature_mask) -> WaveState:
+        """One adaptive-width wave.  The ramp (frontier 1→2→4→…) and the
+        exhausted bottom pay per-wave costs that scale with the BODY width
+        — the (rows, W) member-mask contractions, the 2W-child scans, the
+        bookkeeping — regardless of how few leaves actually split, so a
+        frontier of ≤ 8 positive-gain leaves runs a W=8 body instead.
+        Selection is identical (top-k of the same gain order, same budget
+        guard), so the grown forest is exactly the same."""
+        ws = min(8, self.W)
+        if ws >= self.W:
+            return self._wave_body(st, feature_mask)
+        small = jnp.sum(self._pool_gains(st) > 0.0) <= ws
+        return lax.cond(
+            small,
+            lambda s: self._wave_body(s, feature_mask, width=ws),
+            lambda s: self._wave_body(s, feature_mask), st)
 
     # -- the stall split (exact-replay correction) ---------------------------
 
@@ -911,13 +1062,27 @@ class WaveTPUTreeLearner(CompactTPUTreeLearner):
             self._make_stall_branch(S, sort_mode=S > self._stall_cutoff)
             for S in self._win_sizes]
         st = self._init_root_wave(bins_p, grad, hess, bag, feature_mask)
+        # level-wise opening: the first L levels grow unsorted (level d has
+        # at most 2^d members), then ONE materialization sort compacts
+        # every window; a level with nothing to split is an exact no-op
+        for d in range(self.open_levels):
+            st = self._wave_body(st, feature_mask,
+                                 width=min(1 << d, self.W), opening=True)
+        if self.open_levels > 0:
+            st = lax.cond(st.pending, self._materialize_sort,
+                          lambda s: s, st)
 
         def gcond(s):
             return (s.num_splits < self.grow_budget) & \
                 (jnp.max(self._pool_gains(s)) > 0.0)
 
-        st = lax.while_loop(gcond, lambda s: self._wave_body(s, feature_mask),
+        st = lax.while_loop(gcond, lambda s: self._wave_step(s, feature_mask),
                             st)
+        if self._defer_sorts:
+            # the growth loop may exit on a deferring wave — the replay's
+            # stall splits slice PHYSICAL windows, so materialize first
+            st = lax.cond(st.pending, self._materialize_sort,
+                          lambda s: s, st)
         return self._emit_tree_wave(st, feature_mask)
 
     def _emit_tree_wave(self, st: WaveState, feature_mask):

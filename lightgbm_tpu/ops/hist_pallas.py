@@ -24,6 +24,7 @@ Layout notes:
 from __future__ import annotations
 
 import functools
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -123,7 +124,11 @@ def build_histogram_pallas(bins: jax.Array, w: jax.Array, *, num_bins: int,
 # Bin codes arrive packed 4-per-int32 word (feature 4k+s in byte s of word k)
 # so the partition sort moves 4 features per payload operand.  The weight
 # channels are split into ``nterms`` bf16 terms (w ≈ hi + lo, the one-hot
-# operand is exact in bf16), so each weight carries ~8·nterms mantissa bits
+# operand is exact in bf16).  CONTRACT: weight channel 2 (the count
+# channel) is a {0,1} bag mask — exactly representable in bf16 — so it
+# carries ONE term while grad/hess carry ``nterms`` each
+# (``_expand_terms_mixed``: 2·nterms+1 MXU rows instead of 3·nterms).
+# Each grad/hess weight carries ~8·nterms mantissa bits
 # (nterms=2 → ~16 bits, noticeably below f32's 24; accumulation itself is
 # f32).  That is coarser than the reference GPU kernels' full-f32 regime
 # (`docs/GPU-Performance.rst:137-141`) but runs at nterms MXU passes instead
@@ -146,8 +151,83 @@ def _expand_terms(w_blk, nterms):
     return jnp.concatenate(terms, axis=0)
 
 
+def _expand_terms_mixed(w_blk, nterms):
+    """Term expansion exploiting the count channel's exactness: w_blk rows
+    are (g·bag, h·bag, bag) and bag ∈ {0,1} is exactly representable in
+    bf16, so the count channel needs ONE term while g/h carry ``nterms``
+    each (the dropped count residuals are exact zeros — bit-identical
+    histograms, 2 fewer MXU rows at nterms=3).  Layout: g terms, then h
+    terms, then the single count row — ``_reduce_mixed`` matches it."""
+    gt, ht = [], []
+    rg, rh = w_blk[0:1], w_blk[1:2]
+    for _ in range(nterms):
+        tg = rg.astype(jnp.bfloat16)
+        th = rh.astype(jnp.bfloat16)
+        gt.append(tg)
+        ht.append(th)
+        rg = rg - tg.astype(jnp.float32)
+        rh = rh - th.astype(jnp.float32)
+    return jnp.concatenate(gt + ht + [w_blk[2:3].astype(jnp.bfloat16)],
+                           axis=0)                    # (2*nterms+1, Rb)
+
+
+def _reduce_mixed(part, nterms):
+    """(.., 2*nterms+1, B) term-major partials → (.., 3, B) channels."""
+    t = nterms
+    g = part[..., 0:t, :].sum(axis=-2)
+    h = part[..., t:2 * t, :].sum(axis=-2)
+    c = part[..., 2 * t, :]
+    return jnp.stack([g, h, c], axis=-2)
+
+
+def _radix_word(wt, word, rb: int, bp: int, nterms: int):
+    """One packed word's 4 sub-feature histogram partials via a TWO-LEVEL
+    bin decomposition (the TPU analogue of the OpenCL kernels' bin-size
+    specialization, `src/treelearner/ocl/histogram16.cl` vs `256.cl`):
+    bin = 32·hi + lo.  The 8-wide hi one-hot FOLDS INTO THE WEIGHT OPERAND
+    (A = wt ⊗ hi-onehot, cheap) and only the 32-wide lo one-hot is built
+    per sub-feature — ~2.6× less VPU work than materializing the 256-wide
+    one-hot, which is the packed kernels' measured floor (~6 ms per 1M-row
+    pass on v5e).  The four sub-features batch into ONE
+    ``(4·nt·HI, Rb) × (Rb, 128)`` MXU dot per word (cross-sub-feature
+    products are discarded — the waste equals what lane padding would cost
+    on per-sub-feature dots, and one dot keeps the round-4 rule that MXU
+    dispatch count, not FLOPs, dominates).  Each output bucket receives
+    exactly the rows of its bin, accumulated in the same row order as the
+    one-hot formulation.  Returns a LIST of four (3, HI, 32) channel
+    blocks — the lane dimension stays 32 end-to-end (Mosaic cannot
+    shape-cast across lanes), so callers accumulate into a
+    (…, 4·HI, 32) output and flatten to bins OUTSIDE the kernel."""
+    nt = 2 * nterms + 1
+    hi_n = bp // 32
+    iota_hi = jax.lax.broadcasted_iota(jnp.int32, (hi_n, rb), 0)
+    iota_lo = jax.lax.broadcasted_iota(jnp.int32, (32, rb), 0)
+    a_parts, lo_parts = [], []
+    for s in range(4):
+        code = (word >> (8 * s)) & 0xFF
+        hi_oh = ((code >> 5)[None, :] == iota_hi).astype(jnp.bfloat16)
+        lo_parts.append(((code & 31)[None, :] == iota_lo)
+                        .astype(jnp.bfloat16))
+        a_parts.append((hi_oh[None, :, :] * wt[:, None, :])
+                       .reshape(nt * hi_n, rb))
+    a = jnp.concatenate(a_parts, axis=0)        # (4*nt*HI, Rb)
+    lo = jnp.concatenate(lo_parts, axis=0)      # (128, Rb)
+    part = jax.lax.dot_general(
+        a, lo, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)     # (4*nt*HI, 128)
+    outs = []
+    for s in range(4):
+        blk = part[s * nt * hi_n:(s + 1) * nt * hi_n,
+                   s * 32:(s + 1) * 32]         # (nt*HI, 32)
+        b3 = blk.reshape(nt, hi_n, 32)          # leading split only
+        g = b3[0:nterms].sum(axis=0)
+        h = b3[nterms:2 * nterms].sum(axis=0)
+        outs.append(jnp.stack([g, h, b3[2 * nterms]]))   # (3, HI, 32)
+    return outs
+
+
 def _hist_kernel_packed(bins_ref, w_ref, out_ref, *, num_bins_padded: int,
-                        word_tile: int, nterms: int):
+                        word_tile: int, nterms: int, radix: bool = False):
     # ONE dot per word: the 4 sub-features' one-hots concatenate along the
     # output axis and the bf16 terms stack along the channel axis, so each
     # word costs a single (3*nterms, Rb) x (Rb, 4*B) MXU contraction
@@ -162,9 +242,17 @@ def _hist_kernel_packed(bins_ref, w_ref, out_ref, *, num_bins_padded: int,
     w_blk = w_ref[...]  # (3, Rb) f32
     rb = w_blk.shape[1]
     bp = num_bins_padded
+    if radix and nterms > 0:
+        wt = _expand_terms_mixed(w_blk, nterms)
+        hi_n = bp // 32
+        for wd in range(word_tile):
+            accs = _radix_word(wt, bins_ref[wd, :], rb, bp, nterms)
+            for s in range(4):
+                out_ref[wd, :, s * hi_n:(s + 1) * hi_n, :] += accs[s]
+        return
     iota_b = jax.lax.broadcasted_iota(jnp.int32, (bp, rb), 0)
     if nterms > 0:
-        wt = _expand_terms(w_blk, nterms)        # (3*nterms, Rb)
+        wt = _expand_terms_mixed(w_blk, nterms)  # (2*nterms+1, Rb)
         for wd in range(word_tile):
             word = bins_ref[wd, :]  # (Rb,) int32
             ohs = [(((word >> (8 * s)) & 0xFF)[None, :] == iota_b)
@@ -172,11 +260,8 @@ def _hist_kernel_packed(bins_ref, w_ref, out_ref, *, num_bins_padded: int,
             oh = jnp.concatenate(ohs, axis=0)    # (4B, Rb)
             part = jax.lax.dot_general(
                 wt, oh, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32)  # (3*nterms, 4B)
-            acc = part[:3]
-            for t in range(1, nterms):
-                acc = acc + part[3 * t:3 * (t + 1)]
-            out_ref[wd, :, :] += acc
+                preferred_element_type=jnp.float32)  # (2*nterms+1, 4B)
+            out_ref[wd, :, :] += _reduce_mixed(part, nterms)
     else:  # nterms == 0: full f32 emulation (tpu_hist_precision=highest)
         for wd in range(word_tile):
             word = bins_ref[wd, :]
@@ -192,33 +277,49 @@ def _hist_kernel_packed(bins_ref, w_ref, out_ref, *, num_bins_padded: int,
 
 @functools.partial(jax.jit, static_argnames=("num_bins", "word_tile",
                                              "row_block", "nterms",
-                                             "interpret"))
+                                             "radix", "interpret"))
 def build_histogram_packed(bins_words: jax.Array, w: jax.Array, *,
                            num_bins: int, word_tile: int = 2,
                            row_block: int = 2048, nterms: int = 2,
+                           radix: Optional[bool] = None,
                            interpret: bool = False) -> jax.Array:
     """hist[f,b,c] = Σ_r [byte(bins_words[f//4,r], f%4)==b] · w[c,r].
 
     bins_words : (Fw, S) int32 — 4 features per word, Fw a multiple of
                  ``word_tile``; S a multiple of 1024.
-    w          : (3, S) f32 — (g·m, h·m, m), already masked.
+    w          : (3, S) f32 — (g·m, h·m, m), already masked; channel 2
+                 MUST be a {0,1} bag mask (the mixed bf16 term expansion
+                 gives the count channel one exact term).
     Returns (Fw*4, num_bins, 3) f32.
     """
     fw, s = bins_words.shape
     word_tile, rb, b_pad = _tile_params(fw, s, word_tile, row_block,
                                         num_bins)
+    if radix is None:
+        radix = nterms > 0 and b_pad % 32 == 0
     grid = (fw // word_tile, s // rb)
+    in_specs = [
+        pl.BlockSpec((word_tile, rb), lambda i, j: (i, j)),
+        pl.BlockSpec((3, rb), lambda i, j: (0, j)),
+    ]
+    if radix:
+        # radix output keeps the 32-lane (…, HI, 32) layout; the flatten
+        # to bins is an XLA reshape outside the kernel
+        hi_n = b_pad // 32
+        out_specs = pl.BlockSpec((word_tile, 3, 4 * hi_n, 32),
+                                 lambda i, j: (i, 0, 0, 0))
+        out_shape = jax.ShapeDtypeStruct((fw, 3, 4 * hi_n, 32), jnp.float32)
+    else:
+        out_specs = pl.BlockSpec((word_tile, 3, 4 * b_pad),
+                                 lambda i, j: (i, 0, 0))
+        out_shape = jax.ShapeDtypeStruct((fw, 3, 4 * b_pad), jnp.float32)
     out = pl.pallas_call(
         functools.partial(_hist_kernel_packed, num_bins_padded=b_pad,
-                          word_tile=word_tile, nterms=nterms),
+                          word_tile=word_tile, nterms=nterms, radix=radix),
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((word_tile, rb), lambda i, j: (i, j)),
-            pl.BlockSpec((3, rb), lambda i, j: (0, j)),
-        ],
-        out_specs=pl.BlockSpec((word_tile, 3, 4 * b_pad),
-                               lambda i, j: (i, 0, 0)),
-        out_shape=jax.ShapeDtypeStruct((fw, 3, 4 * b_pad), jnp.float32),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
@@ -248,7 +349,8 @@ def build_histogram_packed(bins_words: jax.Array, w: jax.Array, *,
 
 def _hist_kernel_segment(slot_ref, block_ref, leaf_ref, bins_ref, w_ref,
                          lid_ref, out_ref, *, num_bins_padded: int,
-                         word_tile: int, nterms: int, n_slots: int):
+                         word_tile: int, nterms: int, n_slots: int,
+                         radix: bool = False):
     t = pl.program_id(1)
     slot = slot_ref[t]
     prev = slot_ref[jnp.maximum(t - 1, 0)]
@@ -266,9 +368,18 @@ def _hist_kernel_segment(slot_ref, block_ref, leaf_ref, bins_ref, w_ref,
         w_blk = w_ref[...] * m                      # (3, Rb) masked
         rb = w_blk.shape[1]
         bp = num_bins_padded
+        if radix and nterms > 0:
+            wt = _expand_terms_mixed(w_blk, nterms)
+            hi_n = bp // 32
+            for wd in range(word_tile):
+                accs = _radix_word(wt, bins_ref[wd, :], rb, bp, nterms)
+                for sf in range(4):
+                    out_ref[0, wd, :, sf * hi_n:(sf + 1) * hi_n, :] += \
+                        accs[sf]
+            return
         iota_b = jax.lax.broadcasted_iota(jnp.int32, (bp, rb), 0)
         if nterms > 0:
-            wt = _expand_terms(w_blk, nterms)       # (3*nterms, Rb)
+            wt = _expand_terms_mixed(w_blk, nterms)  # (2*nterms+1, Rb)
         for wd in range(word_tile):
             word = bins_ref[wd, :]
             ohdt = jnp.bfloat16 if nterms > 0 else jnp.float32
@@ -278,10 +389,8 @@ def _hist_kernel_segment(slot_ref, block_ref, leaf_ref, bins_ref, w_ref,
             if nterms > 0:
                 part = jax.lax.dot_general(
                     wt, oh, (((1,), (1,)), ((), ())),
-                    preferred_element_type=jnp.float32)  # (3*nterms, 4B)
-                acc = part[:3]
-                for tm in range(1, nterms):
-                    acc = acc + part[3 * tm:3 * (tm + 1)]
+                    preferred_element_type=jnp.float32)  # (2*nterms+1, 4B)
+                acc = _reduce_mixed(part, nterms)
             else:
                 acc = jax.lax.dot_general(
                     w_blk, oh, (((1,), (1,)), ((), ())),
@@ -292,17 +401,21 @@ def _hist_kernel_segment(slot_ref, block_ref, leaf_ref, bins_ref, w_ref,
 
 @functools.partial(jax.jit, static_argnames=("num_bins", "n_slots",
                                              "word_tile", "row_block",
-                                             "nterms", "interpret"))
+                                             "nterms", "radix",
+                                             "interpret"))
 def build_histogram_segments(bins_words: jax.Array, w: jax.Array,
                              lid: jax.Array, chunk_slot: jax.Array,
                              chunk_block: jax.Array, chunk_leaf: jax.Array,
                              *, num_bins: int, n_slots: int,
                              word_tile: int = 2, row_block: int = 2048,
-                             nterms: int = 2, interpret: bool = False
+                             nterms: int = 2, radix: Optional[bool] = None,
+                             interpret: bool = False
                              ) -> jax.Array:
     """Per-slot histograms over lid-masked row chunks (see block comment).
 
-    bins_words : (Fw, N) int32 packed codes; w (3, N) f32; lid (N,) int32.
+    bins_words : (Fw, N) int32 packed codes; w (3, N) f32 with channel 2 a
+                 {0,1} bag mask (see ``build_histogram_packed``); lid (N,)
+                 int32.
     chunk_*    : (T,) int32 — output slot (== n_slots ⇒ no-op), row-block
                  index, and lid value per chunk; slots non-decreasing.
     Returns (n_slots, Fw*4, num_bins, 3) f32.
@@ -310,7 +423,20 @@ def build_histogram_segments(bins_words: jax.Array, w: jax.Array,
     fw, n = bins_words.shape
     word_tile, rb, b_pad = _tile_params(fw, n, word_tile, row_block,
                                         num_bins)
+    if radix is None:
+        radix = nterms > 0 and b_pad % 32 == 0
     grid = (fw // word_tile, chunk_slot.shape[0])
+    if radix:
+        hi_n = b_pad // 32
+        out_specs = pl.BlockSpec((1, word_tile, 3, 4 * hi_n, 32),
+                                 lambda i, t, s, b, l: (s[t], i, 0, 0, 0))
+        out_shape = jax.ShapeDtypeStruct(
+            (n_slots + 1, fw, 3, 4 * hi_n, 32), jnp.float32)
+    else:
+        out_specs = pl.BlockSpec((1, word_tile, 3, 4 * b_pad),
+                                 lambda i, t, s, b, l: (s[t], i, 0, 0))
+        out_shape = jax.ShapeDtypeStruct((n_slots + 1, fw, 3, 4 * b_pad),
+                                         jnp.float32)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
         grid=grid,
@@ -320,16 +446,14 @@ def build_histogram_segments(bins_words: jax.Array, w: jax.Array,
             pl.BlockSpec((3, rb), lambda i, t, s, b, l: (0, b[t])),
             pl.BlockSpec((rb,), lambda i, t, s, b, l: (b[t],)),
         ],
-        out_specs=pl.BlockSpec((1, word_tile, 3, 4 * b_pad),
-                               lambda i, t, s, b, l: (s[t], i, 0, 0)),
+        out_specs=out_specs,
     )
     out = pl.pallas_call(
         functools.partial(_hist_kernel_segment, num_bins_padded=b_pad,
                           word_tile=word_tile, nterms=nterms,
-                          n_slots=n_slots),
+                          n_slots=n_slots, radix=radix),
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((n_slots + 1, fw, 3, 4 * b_pad),
-                                       jnp.float32),
+        out_shape=out_shape,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
@@ -374,9 +498,10 @@ def _hist_kernel_multislot(bins_ref, w_ref, slot_ref, out_ref, *,
     soh = slot_blk[None, :] == iota_s                      # (K, Rb) bool
     iota_b = jax.lax.broadcasted_iota(jnp.int32, (bp, rb), 0)
     if nterms > 0:
-        wt = _expand_terms(w_blk, nterms)                  # (3T, Rb) bf16
+        wt = _expand_terms_mixed(w_blk, nterms)        # (2T+1, Rb) bf16
+        nt = 2 * nterms + 1
         a = (soh.astype(jnp.bfloat16)[:, None, :] * wt[None, :, :]) \
-            .reshape(n_slots * 3 * nterms, rb)
+            .reshape(n_slots * nt, rb)
         for wd in range(word_tile):
             word = bins_ref[wd, :]
             ohs = [(((word >> (8 * s)) & 0xFF)[None, :] == iota_b)
@@ -384,8 +509,8 @@ def _hist_kernel_multislot(bins_ref, w_ref, slot_ref, out_ref, *,
             oh = jnp.concatenate(ohs, axis=0)              # (4B, Rb)
             part = jax.lax.dot_general(
                 a, oh, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32)        # (K*3T, 4B)
-            acc = part.reshape(n_slots, nterms, 3, 4 * bp).sum(axis=1)
+                preferred_element_type=jnp.float32)        # (K*nt, 4B)
+            acc = _reduce_mixed(part.reshape(n_slots, nt, 4 * bp), nterms)
             out_ref[wd, :, :, :] += acc
     else:  # full f32 emulation (tpu_hist_precision=highest)
         a = (soh.astype(jnp.float32)[:, None, :] * w_blk[None, :, :]) \

@@ -118,6 +118,36 @@ class ShardedCompactLearner(CompactTPUTreeLearner):
     def _rows_len(self) -> int:
         return self.n_local
 
+    # -- forced splits (`serial_tree_learner.cpp:543-663`) -------------------
+    # The reference's parallel learners inherit ForceSplits from the serial
+    # learner (`data_parallel_tree_learner.cpp:257-258` templates over it);
+    # here the shared `_forced_phase_compact` runs inside the shard_map
+    # program — only the histogram-row fetch differs (the pool is feature-
+    # scattered, so the owning device broadcasts the row via a tiny psum).
+
+    def set_forced_splits(self, forced) -> None:
+        self._forced = list(forced) if forced else None
+        self._jit_tree_c = None              # rebuilt lazily with the phase
+
+    def _fix_hrow(self, hrow, fi: int, sum_g, sum_h, cnt):
+        """Single-feature ``Dataset::FixHistogram`` (the sliced pools make
+        the full-width `_fix_histogram` inapplicable)."""
+        db = int(self.np_default_bin[fi])
+        if db <= 0:
+            return hrow
+        totals = jnp.stack([sum_g, sum_h, cnt]).astype(hrow.dtype)
+        others = jnp.sum(hrow, axis=0) - hrow[db]
+        return hrow.at[db].set(totals - others)
+
+    def _forced_hrow(self, state, fs, sum_g, sum_h, cnt):
+        fi = fs.feature_inner
+        owner, loc = divmod(fi, self.fs)
+        row = state.hist_pool[fs.leaf, loc]              # (B, 3) slice row
+        d = lax.axis_index(self.axis)
+        hrow = lax.psum(jnp.where(d == owner, row, jnp.zeros_like(row)),
+                        self.axis)
+        return self._fix_hrow(hrow, fi, sum_g, sum_h, cnt)
+
     # -- sharded data placement ---------------------------------------------
 
     def sharded_bins(self) -> jax.Array:
@@ -180,6 +210,20 @@ class ShardedCompactLearner(CompactTPUTreeLearner):
         """Merged finder over an arbitrary feature subset described by the
         given metadata arrays (a contiguous shard slice, or a gathered
         voting selection)."""
+        # ``Dataset::FixHistogram`` on the subset, mirroring the serial
+        # scan (`learner.py:_feature_cands`): rebuild each default-bin
+        # entry as leaf totals minus the other bins.  An exact no-op on
+        # consistent paths, but FORCED-SPLIT chains carry the reference's
+        # GatherInfo-vs-partition sum inconsistency whose delta lands in
+        # the default bin — without this the sharded scans see different
+        # histograms than serial on forced descendants (round-5 bug).
+        dt = hist.dtype
+        dbm = (jnp.arange(hist.shape[1])[None, :] == default_bin[:, None]) \
+            & (default_bin[:, None] > 0)
+        totals = jnp.stack([sum_g, sum_h, cnt]).astype(dt)
+        others = jnp.sum(jnp.where(dbm[..., None], 0.0, hist), axis=1)
+        hist = jnp.where(dbm[..., None],
+                         (totals[None, :] - others)[:, None, :], hist)
         fsel = hist.shape[0]
         fmask = fmask_sel & ~is_cat
         if not self.has_monotone:
@@ -325,8 +369,14 @@ class ShardedCompactLearner(CompactTPUTreeLearner):
             rec_i=jnp.zeros((L - 1, 2), jnp.int32),
             rec_cat=jnp.zeros((L - 1, self.cat_W), jnp.uint32))
 
+        state = self._forced_phase_compact(state, fmask_pad)
+
         def body(i, st):
-            return self._split_step_compact(st, fmask_pad, i)
+            # records land at cursor num_leaves-1 (like the serial learner)
+            # so the forced phase and best-gain growth share one stream;
+            # iterations past the leaf budget are exact no-ops
+            return self._split_step_compact(st, fmask_pad,
+                                            st.num_leaves - 1)
 
         state = jax.lax.fori_loop(0, L - 1, body, state)
         leaf_id = lax.sort([state.rid_p, state.lid_p], num_keys=1)[1]
@@ -430,6 +480,13 @@ class ShardedVotingLearner(ShardedCompactLearner):
     def _reduce_hist(self, local_hist):
         # the pool stays LOCAL; reduction happens per elected feature set
         return local_hist
+
+    def _forced_hrow(self, state, fs, sum_g, sum_h, cnt):
+        # the voting pool is full-width LOCAL-unreduced: reduce the one
+        # forced feature's row across devices, then fix it
+        hrow = lax.psum(state.hist_pool[fs.leaf, fs.feature_inner],
+                        self.axis)
+        return self._fix_hrow(hrow, fs.feature_inner, sum_g, sum_h, cnt)
 
     def _best_rows_global(self, hist2, crow_sums, fmask_pad, depth_ok,
                           constraints):

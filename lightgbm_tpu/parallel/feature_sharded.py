@@ -193,7 +193,10 @@ class FeatureShardedWaveLearner(FeatureShardedCompactLearner,
                 (jnp.max(self._pool_gains(s)) > 0.0)
 
         st = lax.while_loop(gcond,
-                            lambda s: self._wave_body(s, fmask_pad), st)
+                            lambda s: self._wave_step(s, fmask_pad), st)
+        if self._defer_sorts:
+            st = lax.cond(st.pending, self._materialize_sort,
+                          lambda s: s, st)
         return self._emit_tree_wave(st, fmask_pad)
 
     def train_async(self, grad: jax.Array, hess: jax.Array, bag: jax.Array,

@@ -35,6 +35,27 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from .mesh import make_mesh
 
 
+def _route_log(cfg, msg: str) -> None:
+    """Learner-selection telemetry, mirroring the serial factory's
+    (`learner_compact.py` create_tree_learner): a user with 8 chips and an
+    off-by-one row count must be TOLD they got the slow masked path."""
+    if int(getattr(cfg, "verbosity", 1)) >= 1:
+        print(f"[lightgbm_tpu] {msg}")
+
+
+def _fast_gate_reason(data, mesh_size: int) -> Optional[str]:
+    """Why the sharded compact/wave path cannot run (None = eligible)."""
+    if data.max_num_bin > 256:
+        return f"max_num_bin={data.max_num_bin} > 256"
+    if data.num_data_padded % mesh_size:
+        return (f"padded row count {data.num_data_padded} not divisible by "
+                f"mesh size {mesh_size}")
+    if data.bins.shape[0] % mesh_size:
+        return (f"padded feature count {data.bins.shape[0]} not divisible "
+                f"by mesh size {mesh_size}")
+    return None
+
+
 def apply_parallel_sharding(gbdt, mesh: Mesh, mode: str) -> None:
     """Re-place a GBDT's device arrays for a parallel mode.  Subsequent jitted
     steps compile under GSPMD with collectives over the mesh."""
@@ -46,39 +67,45 @@ def apply_parallel_sharding(gbdt, mesh: Mesh, mode: str) -> None:
     if hasattr(gbdt, "_flush_pending"):
         gbdt._flush_pending()
     learner = gbdt.learner
-    if getattr(learner, "_forced", None):
-        # the reference applies ForceSplits in its parallel learners too
-        # (they subclass SerialTreeLearner); the sharded learners here do
-        # not yet — refuse loudly rather than silently train a different
-        # model
-        raise NotImplementedError(
-            "forcedsplits_filename is not supported with "
-            "tree_learner=data|feature|voting yet; use tree_learner=serial")
+    # forced splits ride the sharded COMPACT learners (the wave learners
+    # don't carry the forced phase, mirroring the serial factory's routing)
+    forced = getattr(learner, "_forced", None)
     mesh_size = max(int(np.prod(mesh.devices.shape)), 1)
-    if mode in ("data", "voting") and learner.data.max_num_bin <= 256 \
-            and learner.data.num_data_padded % mesh_size == 0 \
-            and learner.data.bins.shape[0] % mesh_size == 0:
+    fast_reason = _fast_gate_reason(learner.data, mesh_size) \
+        if mode in ("data", "voting") else None
+    if mode in ("data", "voting") and fast_reason is None:
         # the real distributed path: per-shard compact learner with
         # reduce-scattered histograms; voting adds PV-Tree feature election
         # (`compact_sharded.py`)
         from .compact_sharded import (ShardedCompactLearner,
                                       ShardedVotingLearner)
+        from .wave_sharded import wave_sharded_eligible
+        wave_ok = not forced and wave_sharded_eligible(
+            learner.cfg, learner.data, mesh_size)
         if mode == "voting":
-            from .wave_sharded import (ShardedVotingWaveLearner,
-                                       wave_sharded_eligible)
-            cls = ShardedVotingWaveLearner if wave_sharded_eligible(
-                learner.cfg, learner.data, mesh_size) \
+            from .wave_sharded import ShardedVotingWaveLearner
+            cls = ShardedVotingWaveLearner if wave_ok \
                 else ShardedVotingLearner
         else:
             # data-parallel rides the frontier-wave learner where eligible
             # (the reference templates its parallel learners over its
             # fastest serial learner, `data_parallel_tree_learner.cpp:257`)
-            from .wave_sharded import (ShardedWaveLearner,
-                                       wave_sharded_eligible)
-            cls = ShardedWaveLearner if wave_sharded_eligible(
-                learner.cfg, learner.data, mesh_size) \
-                else ShardedCompactLearner
+            from .wave_sharded import ShardedWaveLearner
+            cls = ShardedWaveLearner if wave_ok else ShardedCompactLearner
+        if not wave_ok:
+            why = "forced splits ride the sequential sharded learner" \
+                if forced else "shape/byte gates, see wave_sharded_eligible"
+            _route_log(learner.cfg,
+                       f"tree_learner={mode}: wave-sharded learner "
+                       f"ineligible ({why}); using the sequential "
+                       f"{cls.__name__}")
+        else:
+            _route_log(learner.cfg,
+                       f"tree_learner={mode}: using {cls.__name__} over "
+                       f"{mesh_size} devices")
         gbdt.learner = cls(learner.cfg, learner.data, mesh)
+        if forced:
+            gbdt.learner.set_forced_splits(forced)
         _place_row_arrays(gbdt, mesh, mode)
         gbdt._mesh = mesh
         gbdt._parallel_mode = mode
@@ -93,23 +120,45 @@ def apply_parallel_sharding(gbdt, mesh: Mesh, mode: str) -> None:
             # must pass the serial wave gates at the FULL row count and
             # width (wide datasets use the feature-sharded compact learner
             # — its scans are feature-sliced either way)
-            wave_ok = (learner.cfg.tpu_learner in ("auto", "wave")
+            wave_ok = (not forced
+                       and learner.cfg.tpu_learner in ("auto", "wave")
                        and wave_budget_reason(
                            learner.cfg, int(learner.data.num_data_padded),
                            learner.data.bins.shape[0],
                            int(learner.data.max_num_bin)) is None)
             cls = FeatureShardedWaveLearner if wave_ok \
                 else FeatureShardedCompactLearner
+            _route_log(learner.cfg,
+                       f"tree_learner=feature: using {cls.__name__} over "
+                       f"{mesh_size} devices")
             gbdt.learner = cls(learner.cfg, learner.data, mesh)
+            if forced:
+                gbdt.learner.set_forced_splits(forced)
             gbdt._mesh = mesh
             gbdt._parallel_mode = mode
             return
+    # every fast path refused — name the failed gate before draping GSPMD
+    # over the masked learner (round-2-era performance)
+    if mode in ("data", "voting"):
+        _route_log(learner.cfg,
+                   f"tree_learner={mode}: sharded compact/wave path "
+                   f"ineligible ({fast_reason}); falling back to the "
+                   f"masked GSPMD learner")
+    elif mode == "feature":
+        why = (f"max_num_bin={learner.data.max_num_bin} > 256"
+               if learner.data.max_num_bin > 256
+               else "feature_sharded_eligible gates failed")
+        _route_log(learner.cfg,
+                   f"tree_learner=feature: feature-sharded path ineligible "
+                   f"({why}); falling back to the masked GSPMD learner")
     if type(learner) is not TPUTreeLearner:
         # feature-parallel / >256-bin fallbacks drape GSPMD over the masked
         # learner — the compact learner's packed-bin cache and global-axis
         # sort would silently ignore the sharding mutations below
         learner = TPUTreeLearner(learner.cfg, learner.data,
                                  learner.hist_backend)
+        if forced:
+            learner.set_forced_splits(forced)
         gbdt.learner = learner
     if mode in ("data", "voting"):
         bins_spec = P(None, axis)      # (F, N): shard rows
@@ -148,8 +197,10 @@ def apply_parallel_sharding(gbdt, mesh: Mesh, mode: str) -> None:
                     if mode in ("data", "voting") else P()
                 try:
                     setattr(obj, name, put(arr, spec))
-                except Exception:
-                    pass
+                except Exception as e:
+                    import warnings
+                    warnings.warn(f"could not shard objective array "
+                                  f"{name!r} over the mesh: {e}")
     gbdt._mesh = mesh
     gbdt._parallel_mode = mode
 

@@ -52,6 +52,10 @@ class ShardedWaveLearner(ShardedCompactLearner, WaveTPUTreeLearner):
         # wave bookkeeping over the PADDED feature axis (no EFB bundles in
         # the sharded path; metadata was padded by the sharded __init__)
         self._init_wave_dims(cfg)
+        # the sharded program keeps the round-4 per-wave flow (one
+        # collective per wave); the serial opening's multi-slot kernel has
+        # no exchange seam yet — growth starts at wave 1 as before
+        self.open_levels = 0
         self.fw_col = jnp.arange(self.f_pad, dtype=jnp.int32)
         self.fw_goff = jnp.zeros(self.f_pad, jnp.int32)
         self.fw_bnd = jnp.zeros(self.f_pad, jnp.int32)
@@ -123,7 +127,10 @@ class ShardedWaveLearner(ShardedCompactLearner, WaveTPUTreeLearner):
                 (jnp.max(self._pool_gains(s)) > 0.0)
 
         st = lax.while_loop(gcond,
-                            lambda s: self._wave_body(s, fmask_pad), st)
+                            lambda s: self._wave_step(s, fmask_pad), st)
+        if self._defer_sorts:
+            st = lax.cond(st.pending, self._materialize_sort,
+                          lambda s: s, st)
         return self._emit_tree_wave(st, fmask_pad)
 
     def train_async(self, grad: jax.Array, hess: jax.Array, bag: jax.Array,

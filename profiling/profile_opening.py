@@ -1,0 +1,127 @@
+"""Phase attribution of the opening-augmented wave tree on the real TPU.
+
+Compiles truncated programs and differences timings:
+  root            — root init only
+  open            — root + opening levels (no sort)
+  mat             — root + opening + materialization sort
+  grow            — mat + the full wave while_loop (no replay/emit)
+  growN           — mat + N waves (marginal wave cost)
+  full            — the shipped program (replay = full - grow)
+
+Usage: python profiling/profile_opening.py [rows] [variants...]
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+from jax import lax  # noqa: E402
+
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+
+from profiling.profile_wave_marginal import make  # noqa: E402
+
+
+def timed(fn, args, iters=8):
+    out = fn(*args)
+    jax.tree_util.tree_map(lambda a: None, out)
+    sync = np.asarray(jax.tree_util.tree_leaves(out)[0]).ravel()
+    float(sync[0])
+    best = float("inf")
+    for _ in range(2):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn(*args)
+        float(np.asarray(jax.tree_util.tree_leaves(out)[0]).ravel()[0])
+        best = min(best, (time.perf_counter() - t0) / iters)
+    return best * 1e3
+
+
+def main():
+    rows = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
+    variants = sys.argv[2:] or ["root", "open", "mat", "grow", "full"]
+    learner, grad, hess, bag = make(rows)
+    self = learner
+    fm = jnp.ones(self.num_features, dtype=bool)
+    bp = self.bins_packed()
+
+    def alive(st):
+        # keep EVERY state component alive so XLA cannot DCE a phase out
+        # of a truncated program (cheap: strided sub-reductions)
+        return (st.cand_f,
+                st.key_p[::997].sum() + st.lid_p[::997].sum()
+                + st.rid_p[::997].sum() + st.bins_p[0, ::997].sum()
+                + st.node_i.sum() + st.num_splits,
+                st.w_p[2, ::997].sum() + st.hist_pool[:, 0, 0, 0].sum())
+
+    def build(upto, waves=-1, levels=None):
+        def tree(bins_p, grad, hess, bag, feature_mask):
+            self._hist_branches = [self._make_hist_branch(S)
+                                   for S in self._win_sizes]
+            self._stall_branches = [
+                self._make_stall_branch(S, sort_mode=S > self._stall_cutoff)
+                for S in self._win_sizes]
+            st = self._init_root_wave(bins_p, grad, hess, bag, feature_mask)
+            if upto == "root":
+                return alive(st)
+            nl = self.open_levels if levels is None else levels
+            for d in range(nl):
+                st = self._wave_body(st, feature_mask,
+                                     width=min(1 << d, self.W),
+                                     opening=True)
+            if upto == "open":
+                return alive(st)
+            if self.open_levels > 0:
+                st = self._materialize_sort(st)
+            if upto == "mat":
+                return alive(st)
+
+            if waves < 0:
+                def gcond(s):
+                    return (s.num_splits < self.grow_budget) & \
+                        (jnp.max(self._pool_gains(s)) > 0.0)
+                st = lax.while_loop(
+                    gcond, lambda s: self._wave_body(s, feature_mask), st)
+            else:
+                def gcond(c):
+                    s, k = c
+                    return (k < waves) & \
+                        (s.num_splits < self.grow_budget) & \
+                        (jnp.max(self._pool_gains(s)) > 0.0)
+                st, _ = lax.while_loop(
+                    gcond,
+                    lambda c: (self._wave_body(c[0], feature_mask), c[1] + 1),
+                    (st, jnp.asarray(0, jnp.int32)))
+            return alive(st)
+
+        return jax.jit(tree)
+
+    for v in variants:
+        if v == "full":
+            t = timed(lambda *a: self._jit_tree_w(*a),
+                      (bp, grad, hess, bag, fm))
+            print(f"{v:8s} {t:8.1f} ms", flush=True)
+        elif v.startswith("grow") and len(v) > 4:
+            fn = build("grow", waves=int(v[4:]))
+            t = timed(fn, (bp, grad, hess, bag, fm))
+            out = fn(bp, grad, hess, bag, fm)
+            spl = int(np.asarray(out[1]))  # includes key/lid sums — rough
+            print(f"{v:8s} {t:8.1f} ms   alive1={spl}", flush=True)
+        elif v.startswith("open") and len(v) > 4:
+            fn = build("open", levels=int(v[4:]))
+            t = timed(fn, (bp, grad, hess, bag, fm))
+            print(f"{v:8s} {t:8.1f} ms", flush=True)
+        else:
+            fn = build(v)
+            t = timed(fn, (bp, grad, hess, bag, fm))
+            print(f"{v:8s} {t:8.1f} ms", flush=True)
+
+
+if __name__ == "__main__":
+    main()

