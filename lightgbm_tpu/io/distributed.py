@@ -117,6 +117,22 @@ def partition_rows(num_rows: int, rank: int, num_machines: int,
     return np.arange(rank, num_rows, num_machines, dtype=np.int64)
 
 
+def partition_queries(group_sizes: np.ndarray, rank: int,
+                      num_machines: int):
+    """Query-aware dealing — ``Metadata::CheckOrPartition``
+    (`src/io/metadata.cpp`, `include/LightGBM/dataset.h:82`): ranking data
+    is dealt by QUERY (query q → machine ``q % num_machines``) so no group
+    is ever torn across machines.  Returns (owned_row_indices,
+    owned_group_sizes)."""
+    sizes = np.asarray(group_sizes, dtype=np.int64).reshape(-1)
+    starts = np.concatenate([[0], np.cumsum(sizes)])
+    owned_q = np.arange(rank, len(sizes), num_machines, dtype=np.int64)
+    rows = np.concatenate(
+        [np.arange(starts[q], starts[q + 1], dtype=np.int64)
+         for q in owned_q]) if len(owned_q) else np.zeros(0, np.int64)
+    return rows, sizes[owned_q]
+
+
 def load_partitioned_file(path: str, params: Dict, rank: int,
                           num_machines: int, pre_partition: bool = False):
     """Read a text data file keeping only this rank's rows (mod-partition
@@ -127,10 +143,12 @@ def load_partitioned_file(path: str, params: Dict, rank: int,
     maps local row k to its global data-row index (for
     ``distributed_construct``'s sample alignment).  Sidecar ``.weight`` /
     ``.query`` files are read from the ORIGINAL path; weights are subset to
-    the owned rows, query files require ``pre_partition`` (a mod-partition
-    would tear query groups apart, `src/io/metadata.cpp` CheckOrPartition).
+    the owned rows.  With a ``.query`` sidecar the mod-partition deals
+    WHOLE QUERY GROUPS (query q → machine q mod num_machines —
+    ``Metadata::CheckOrPartition``, `src/io/metadata.cpp`), so distributed
+    lambdarank works on non-pre-split data.
     """
-    from .parser import load_data_file
+    from .parser import _load_sidecar, load_data_file
 
     if pre_partition or num_machines == 1:
         mat, label, weight, group = load_data_file(path, params)
@@ -139,10 +157,19 @@ def load_partitioned_file(path: str, params: Dict, rank: int,
     params = dict(params or {})
     has_header = str(params.get("header", params.get("has_header", "false"))
                      ).lower() in ("true", "1")
+    # ranking sidecar first: it decides the dealing (by query, not by row)
+    full_group = _load_sidecar(path + ".query")
+    owned_sorted = None
+    qgroup = None
+    if full_group is not None:
+        owned_q_rows, qgroup = partition_queries(full_group, rank,
+                                                 num_machines)
+        owned_sorted = owned_q_rows      # ascending (whole-query ranges)
     # stream: only OWNED lines are kept, so peak memory is the shard
     header = None
     shard_lines = []
     n_data = 0
+    optr = 0
     with open(path, "r") as fh:
         for ln in fh:
             if not ln.strip():
@@ -150,10 +177,26 @@ def load_partitioned_file(path: str, params: Dict, rank: int,
             if has_header and header is None:
                 header = ln
                 continue
-            if n_data % num_machines == rank:
+            if owned_sorted is not None:
+                if optr < len(owned_sorted) and owned_sorted[optr] == n_data:
+                    shard_lines.append(ln)
+                    optr += 1
+            elif n_data % num_machines == rank:
                 shard_lines.append(ln)
             n_data += 1
-    owned = partition_rows(n_data, rank, num_machines, pre_partition=False)
+    if owned_sorted is not None:
+        # the reference errors on ANY query-sum/data-row mismatch
+        # (`Metadata::CheckOrPartition`); checking the total on EVERY rank
+        # also keeps an overcount from stranding non-tail ranks in the
+        # subsequent collectives
+        qsum = int(np.sum(full_group))
+        if qsum != n_data:
+            raise ValueError(
+                f"query file rows ({qsum}) != data rows ({n_data})")
+        owned = owned_sorted
+    else:
+        owned = partition_rows(n_data, rank, num_machines,
+                               pre_partition=False)
     if header is not None:
         shard_lines = [header] + shard_lines
 
@@ -168,15 +211,9 @@ def load_partitioned_file(path: str, params: Dict, rank: int,
     finally:
         os.unlink(tmp)
     # sidecars live next to the ORIGINAL file, not the temp shard
-    from .parser import _load_sidecar
     full_weight = _load_sidecar(path + ".weight")
     weight = full_weight[owned] if full_weight is not None else None
-    full_group = _load_sidecar(path + ".query")
-    if full_group is not None:
-        raise ValueError(
-            "query/group files require pre_partition=true: a mod row "
-            "partition would tear query groups across machines")
-    return mat, label, weight, None, owned
+    return mat, label, weight, qgroup, owned
 
 
 def _feature_ranges(num_features: int, num_machines: int):
@@ -197,6 +234,7 @@ def distributed_construct(net, shard: np.ndarray, cfg: Config,
                           categorical: Sequence[int] = (),
                           feature_names: Optional[List[str]] = None,
                           label: Optional[np.ndarray] = None,
+                          group: Optional[np.ndarray] = None,
                           global_rows: Optional[np.ndarray] = None,
                           ) -> _ConstructedDataset:
     """Construct this rank's row shard of a dataset with globally-identical
@@ -284,6 +322,8 @@ def distributed_construct(net, shard: np.ndarray, cfg: Config,
     ds.metadata = Metadata(n_local)
     if label is not None:
         ds.metadata.set_label(np.asarray(label).reshape(-1))
+    if group is not None and len(group):
+        ds.metadata.set_group(np.asarray(group).reshape(-1))
     keep = [j for j, m in enumerate(all_mappers) if not m.is_trivial]
     ds.bin_mappers = [all_mappers[j] for j in keep]
     ds.used_feature_map = np.asarray(keep, dtype=np.int32)

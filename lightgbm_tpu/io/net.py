@@ -1,0 +1,200 @@
+"""TCP-socket network for multi-process dataset construction.
+
+The real-deployment backing for the ``allgather``/``sync_min``/``sync_max``
+seam in `io/distributed.py` — the role the reference's socket linkers play
+for its multi-machine loader (`src/network/linkers_socket.cpp:77-218`
+builds the TCP mesh, `src/network/network.cpp` runs Allgather over it).
+
+Design: rank 0 listens on the first machine-list entry; every other rank
+connects once at construction.  Each collective is a length-prefixed
+pickled relay through rank 0 (a star).  The reference uses
+bruck / recursive-halving point-to-point allgathers — dataset
+construction exchanges a handful of small payloads (sample rows +
+serialized BinMappers), so topology is not the bottleneck and the
+``Network`` API semantics are identical.  The TRAINING collectives never
+touch this class: there the mesh is the network (XLA collectives over
+ICI/DCN, SURVEY §2.6).
+
+Wire format: 8-byte little-endian length + pickle.  Every collective is
+sequence-numbered; a mismatch (ranks running different call sequences)
+raises instead of silently mixing payloads.
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+import time
+from typing import List, Optional, Tuple
+
+_LEN = struct.Struct("<Q")
+_HDR = struct.Struct("<iq")          # (rank, seq)
+
+
+def _send_msg(sock: socket.socket, rank: int, seq: int, payload) -> None:
+    blob = pickle.dumps(payload, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(_HDR.pack(rank, seq) + _LEN.pack(len(blob)) + blob)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed during collective")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _recv_msg(sock: socket.socket) -> Tuple[int, int, object]:
+    rank, seq = _HDR.unpack(_recv_exact(sock, _HDR.size))
+    (ln,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+    return rank, seq, pickle.loads(_recv_exact(sock, ln))
+
+
+class SocketNet:
+    """Multi-process ``Network`` role over TCP (see module docstring).
+
+    Usage (every process)::
+
+        net = SocketNet(rank, num_machines, master=("host", port))
+        ds = distributed_construct(net, shard, cfg, ...)
+        net.close()
+    """
+
+    def __init__(self, rank: int, num_machines: int,
+                 master: Tuple[str, int], timeout: float = 120.0):
+        if not (0 <= rank < num_machines):
+            raise ValueError(f"rank {rank} outside [0, {num_machines})")
+        self.rank = int(rank)
+        self.num_machines = int(num_machines)
+        self._seq = 0
+        self._timeout = timeout
+        self._conns: List[Optional[socket.socket]] = [None] * num_machines
+        self._sock: Optional[socket.socket] = None
+        if num_machines == 1:
+            return
+        if rank == 0:
+            srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            srv.settimeout(timeout)
+            srv.bind((master[0], master[1]))
+            srv.listen(num_machines)
+            self._srv = srv
+            for _ in range(num_machines - 1):
+                conn, _addr = srv.accept()
+                conn.settimeout(timeout)
+                r, seq, _ = _recv_msg(conn)       # hello: peer rank
+                if seq != -1 or not (0 < r < num_machines):
+                    raise ConnectionError(f"bad hello from rank {r}")
+                if self._conns[r] is not None:
+                    raise ConnectionError(f"duplicate rank {r}")
+                self._conns[r] = conn
+        else:
+            # retry while rank 0 comes up (the reference's TryBind/Connect
+            # loop, `linkers_socket.cpp:163-218`)
+            deadline = time.monotonic() + timeout
+            last = None
+            while True:
+                try:
+                    s = socket.create_connection(master, timeout=timeout)
+                    break
+                except OSError as e:
+                    last = e
+                    if time.monotonic() > deadline:
+                        raise ConnectionError(
+                            f"rank {rank} could not reach master "
+                            f"{master}: {last}") from last
+                    time.sleep(0.05)
+            s.settimeout(timeout)
+            self._sock = s
+            _send_msg(s, self.rank, -1, None)     # hello
+
+    # -- collectives ---------------------------------------------------------
+
+    def allgather(self, obj) -> List:
+        if self.num_machines == 1:
+            return [obj]
+        seq = self._seq
+        self._seq += 1
+        if self.rank == 0:
+            slots: List = [None] * self.num_machines
+            slots[0] = obj
+            for r in range(1, self.num_machines):
+                pr, pseq, payload = _recv_msg(self._conns[r])
+                if pseq != seq:
+                    raise ConnectionError(
+                        f"collective sequence mismatch: rank {pr} at "
+                        f"{pseq}, master at {seq}")
+                slots[pr] = payload
+            for r in range(1, self.num_machines):
+                _send_msg(self._conns[r], 0, seq, slots)
+            return slots
+        _send_msg(self._sock, self.rank, seq, obj)
+        _pr, pseq, slots = _recv_msg(self._sock)
+        if pseq != seq:
+            raise ConnectionError(
+                f"collective sequence mismatch: got {pseq}, expected {seq}")
+        return slots
+
+    def sync_min(self, v: int) -> int:
+        return min(self.allgather(int(v)))
+
+    def sync_max(self, v: int) -> int:
+        return max(self.allgather(int(v)))
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def close(self) -> None:
+        for c in self._conns:
+            if c is not None:
+                try:
+                    c.close()
+                except OSError:
+                    pass
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+        srv = getattr(self, "_srv", None)
+        if srv is not None:
+            try:
+                srv.close()
+            except OSError:
+                pass
+
+    def __enter__(self) -> "SocketNet":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def parse_machine_list(path: str) -> List[Tuple[str, int]]:
+    """``machine_list_filename`` format (`docs/Parallel-Learning-Guide.rst`):
+    one ``ip port`` per line; the FIRST entry is the master."""
+    out: List[Tuple[str, int]] = []
+    with open(path) as fh:
+        for ln in fh:
+            ln = ln.strip()
+            if not ln or ln.startswith("#"):
+                continue
+            host, port = ln.split()[:2]
+            out.append((host, int(port)))
+    return out
+
+
+def net_from_config(cfg, rank: int) -> SocketNet:
+    """Build the construction-phase net from the reference's config surface
+    (``num_machines`` / ``machine_list_filename`` / ``time_out``)."""
+    machines = parse_machine_list(cfg.machine_list_filename) \
+        if cfg.machine_list_filename else [("127.0.0.1",
+                                            int(cfg.local_listen_port))]
+    if len(machines) < int(cfg.num_machines):
+        raise ValueError(
+            f"machine list has {len(machines)} entries but "
+            f"num_machines={cfg.num_machines}")
+    return SocketNet(rank, int(cfg.num_machines), master=machines[0],
+                     timeout=float(cfg.time_out))

@@ -1,0 +1,45 @@
+"""Subprocess worker for the multi-process SocketNet test: bins ONE
+mod-partitioned shard of a real data file over the TCP net and pickles the
+resulting mapper table + binned shard for the parent to compare."""
+
+import os
+import pickle
+import sys
+
+os.environ.setdefault("JAX_PLATFORM_NAME", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    rank = int(sys.argv[1])
+    num_machines = int(sys.argv[2])
+    port = int(sys.argv[3])
+    data_path = sys.argv[4]
+    out_path = sys.argv[5]
+
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.io.distributed import (distributed_construct,
+                                             load_partitioned_file)
+    from lightgbm_tpu.io.net import SocketNet
+
+    params = {"max_bin": 63, "min_data_in_bin": 3,
+              "bin_construct_sample_cnt": 2000, "label_column": "0"}
+    cfg = Config.from_params(params)
+    mat, label, _w, _g, rows = load_partitioned_file(
+        data_path, params, rank, num_machines, pre_partition=False)
+    with SocketNet(rank, num_machines, ("127.0.0.1", port)) as net:
+        ds = distributed_construct(net, mat, cfg, categorical=[4],
+                                   label=label, global_rows=rows)
+    with open(out_path, "wb") as fh:
+        pickle.dump({
+            "mappers": [m.to_dict() for m in ds.bin_mappers],
+            "used": ds.used_feature_map,
+            "bins": ds.bins[:len(ds.bin_mappers), :ds.num_data],
+            "global_rows": ds.global_rows,
+            "num_data_global": ds.num_data_global,
+            "n_local": ds.num_data,
+        }, fh)
+
+
+if __name__ == "__main__":
+    main()
