@@ -337,8 +337,13 @@ class Config:
     tpu_wave_max_bytes: int = 1 << 32
     # speculative growth overshoot as a fraction of (num_leaves - 1):
     # extra bottom waves pre-split the leaves the exact greedy replay will
-    # want, trading cheap frozen-window waves for expensive replay stalls
-    tpu_wave_overshoot: float = 0.25
+    # want, trading extra (cheap-at-small-N) waves for expensive replay
+    # stalls.  The optimum is SCALE-DEPENDENT (round-5 sweeps on v5e,
+    # after sort-deferral): 0.65-0.75 wins at 1M rows (-12 ms/tree vs
+    # 0.25) but 0.25 wins at 10.5M (extra waves' full-array passes scale
+    # with N while stall windows don't).  -1 = auto: 0.7 up to 2M local
+    # rows, 0.25 above
+    tpu_wave_overshoot: float = -1.0
     # wave members whose window is at or below this size split in place
     # (lid-lane rewrite, children share the parent span) instead of joining
     # the global re-compaction sort; a wave with no sortable member skips

@@ -327,10 +327,42 @@ def distributed_construct(net, shard: np.ndarray, cfg: Config,
     keep = [j for j, m in enumerate(all_mappers) if not m.is_trivial]
     ds.bin_mappers = [all_mappers[j] for j in keep]
     ds.used_feature_map = np.asarray(keep, dtype=np.int32)
-    # is_reference_linked=True skips the EFB exclusivity scan: bundles are
-    # derived from local rows and would disagree across ranks (the parallel
-    # learners consume unbundled columns anyway)
+    # is_reference_linked=True skips the LOCAL exclusivity scan (bundles
+    # from local rows would disagree across ranks); rank-identical bundles
+    # are derived from the GLOBAL sample below instead
     ds._bin_all(shard, cfg, is_reference_linked=True)
+    # ---- EFB from the allgathered GLOBAL sample: the reference bundles at
+    # Dataset construction from sampled indices (`src/io/dataset.cpp:139`
+    # FastFeatureBundling); here the sample is the same global sequence on
+    # every rank, so the greedy exclusivity grouping is deterministic and
+    # IDENTICAL everywhere — the round-4 blocker ("bundles would disagree
+    # across ranks") is gone.  NOTE the sharded learners still consume
+    # unbundled columns this round (`_supports_bundle = False` — their
+    # feature-axis scatter assumes one feature per column); the bundle is
+    # attached for the serial learners and as the agreed layout for a
+    # future group-axis scatter.
+    # mirror the serial consumption gates (`dataset.py:_bin_all`): only the
+    # serial compact/wave learners consume bundles today, so skip the
+    # global-sample scan when the run is headed for a sharded learner
+    # (whose feature-axis scatter assumes one feature per column)
+    if cfg.enable_bundle and cfg.tree_learner == "serial" \
+            and cfg.tpu_learner in ("auto", "wave", "compact") \
+            and ds.max_num_bin <= 256 \
+            and len(ds.bin_mappers) > 1 and total_sample_cnt > 0:
+        from ..efb import apply_bundles, find_bundles
+
+        class _SampleView:
+            """find_bundles duck-type over the GLOBAL sample's bins."""
+            num_data = total_sample_cnt
+            num_used_features = len(ds.bin_mappers)
+            bin_mappers = ds.bin_mappers
+            bins = np.stack([m.values_to_bins(sample[:, int(j)])
+                             for j, m in zip(ds.used_feature_map,
+                                             ds.bin_mappers)])
+
+        groups = find_bundles(_SampleView, cfg)
+        if any(len(g) > 1 for g in groups):
+            ds.bundle = apply_bundles(ds, groups)
     ds.global_rows = global_rows
     ds.row_offset = offset          # contiguous-layout convenience
     ds.num_data_global = n_total

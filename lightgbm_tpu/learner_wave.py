@@ -62,6 +62,18 @@ from .ops.lookup import lookup_int
 _HIGH = lax.Precision.HIGHEST
 
 
+def _resolve_overshoot(cfg: Config, local_rows: int) -> float:
+    """Scale-aware auto for ``tpu_wave_overshoot`` (see config.py): the
+    extra speculative waves' full-array passes cost ∝N while the replay
+    stalls they prevent cost ~window-sized work, so the optimum drops as
+    the (local) row count grows — measured 0.7 at 1M vs 0.25 at 10.5M on
+    v5e."""
+    ov = float(cfg.tpu_wave_overshoot)
+    if ov < 0:
+        ov = 0.7 if local_rows <= 2_000_000 else 0.25
+    return ov
+
+
 class WaveState(NamedTuple):
     # row payloads, permuted so every leaf's rows are contiguous
     bins_p: jax.Array     # (fw, N) int32 packed bin words
@@ -133,9 +145,16 @@ class WaveTPUTreeLearner(CompactTPUTreeLearner):
         splits, the replay correction <= budget more."""
         self.budget = self.num_leaves - 1
         self.W = max(1, min(int(cfg.tpu_wave_width), self.budget))
+        try:
+            rows = self._rows_len()
+        except AttributeError:
+            # sharded learners reach here mid-MRO (WaveTPUTreeLearner's
+            # __init__ runs before ShardedCompactLearner sets n_local);
+            # their own __init__ re-runs _init_wave_dims with local rows
+            rows = self.n_pad
+        ov = _resolve_overshoot(cfg, rows)
         self.grow_budget = min(
-            self.budget + int(np.ceil(self.budget
-                                      * float(cfg.tpu_wave_overshoot))),
+            self.budget + int(np.ceil(self.budget * ov)),
             2 * self.budget)
         # level-wise opening depth (see Config.tpu_wave_open_levels).
         # MEASURED on the v5e (round 5, profiling/profile_opening.py + a
@@ -1165,7 +1184,8 @@ def wave_budget_reason(cfg: Config, n_pad: int, f_pad: int, b: int
                "a masked sum over words)"
     budget = max(int(cfg.num_leaves), 2) - 1
     W = min(int(cfg.tpu_wave_width), budget)
-    grow = min(budget + int(np.ceil(budget * float(cfg.tpu_wave_overshoot))),
+    grow = min(budget + int(np.ceil(budget
+                                    * _resolve_overshoot(cfg, n_pad))),
                2 * budget)
     M = 1 + 2 * (grow + budget)
     h_bytes = (grow + budget + 2) * f_pad * b * 3 * 4

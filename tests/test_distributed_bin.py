@@ -217,8 +217,8 @@ def test_socket_net_multiprocess_mappers_match_single_host(
     data_path = str(tmp_path / "train.csv")
     with open(data_path, "w") as fh:
         for i in range(len(X)):
-            row = [f"{y[i]:g}"] + [("nan" if np.isnan(v) else f"{v!r}")
-                                   for v in X[i]]
+            row = [f"{y[i]:g}"] + [("nan" if np.isnan(v)
+                                    else repr(float(v))) for v in X[i]]
             fh.write(",".join(row) + "\n")
 
     port = _free_port()
@@ -279,7 +279,7 @@ def test_query_aware_mod_partition_distributed_lambdarank(tmp_path):
     with open(path, "w") as fh:
         for i in range(n):
             fh.write(",".join([f"{rel[i]:d}"]
-                              + [f"{v!r}" for v in X[i]]) + "\n")
+                              + [repr(float(v)) for v in X[i]]) + "\n")
     with open(path + ".query", "w") as fh:
         fh.write("\n".join(str(s) for s in sizes) + "\n")
 
@@ -348,3 +348,65 @@ def test_query_aware_mod_partition_distributed_lambdarank(tmp_path):
     d = ndcg(Xr, yr, gr, "data")
     for k in s:
         assert abs(s[k] - d[k]) < 1e-6, (k, s[k], d[k])
+
+
+@pytest.mark.parametrize("num_machines", [2, 3])
+def test_distributed_efb_bundles_rank_identical(num_machines):
+    """Round-4 missing item 4: EFB bundles are now derived from the
+    allgathered GLOBAL sample, so every rank computes the IDENTICAL greedy
+    grouping (the reference's FastFeatureBundling-over-sample,
+    `src/io/dataset.cpp:139`) — no rank disagreement, regardless of the
+    row sharding."""
+    rng = np.random.RandomState(9)
+    n = 4000
+    dense = rng.randn(n, 2)
+    sparse = np.zeros((n, 6))
+    sparse[np.arange(n), rng.randint(0, 6, n)] = rng.rand(n)
+    sparse[rng.rand(n) < 0.5, :] = 0.0
+    X = np.column_stack([dense, sparse])
+    cfg = Config.from_params({"max_bin": 63, "enable_bundle": True,
+                              "bin_construct_sample_cnt": 2000})
+
+    cuts = np.linspace(0, n, num_machines + 1).astype(int)
+    shards = [(X[cuts[r]:cuts[r + 1]],) for r in range(num_machines)]
+    outs = LoopbackCluster(num_machines).run(
+        lambda net, shard: distributed_construct(net, shard, cfg), shards)
+    assert all(o.bundle is not None for o in outs)
+    g0 = outs[0].bundle.groups
+    assert any(len(g) > 1 for g in g0)       # the sparse block bundled
+    for o in outs[1:]:
+        assert o.bundle.groups == g0
+        np.testing.assert_array_equal(o.bundle.f_gcol,
+                                      outs[0].bundle.f_gcol)
+        np.testing.assert_array_equal(o.bundle.f_off,
+                                      outs[0].bundle.f_off)
+
+    # mod-partitioned shards (different local row sets) agree too
+    outs2 = LoopbackCluster(num_machines).run(
+        lambda net, shard, rows: distributed_construct(
+            net, shard, cfg, global_rows=rows),
+        [(X[r::num_machines],
+          np.arange(r, n, num_machines, dtype=np.int64))
+         for r in range(num_machines)])
+    for o in outs2:
+        assert o.bundle is not None and o.bundle.groups == g0
+
+
+def test_socket_net_from_config(tmp_path):
+    """The reference config surface (machine_list_filename /
+    local_listen_port / time_out) builds the construction net."""
+    from lightgbm_tpu.io.net import net_from_config, parse_machine_list
+
+    ml = tmp_path / "mlist.txt"
+    ml.write_text("# master first\n127.0.0.1 45871\n127.0.0.1 45872\n")
+    assert parse_machine_list(str(ml)) == [("127.0.0.1", 45871),
+                                           ("127.0.0.1", 45872)]
+    cfg = Config.from_params({"num_machines": 1,
+                              "machine_list_filename": str(ml)})
+    net = net_from_config(cfg, 0)       # single machine: no sockets open
+    assert net.allgather("x") == ["x"]
+    net.close()
+    cfg3 = Config.from_params({"num_machines": 3,
+                               "machine_list_filename": str(ml)})
+    with pytest.raises(ValueError, match="machine list"):
+        net_from_config(cfg3, 0)

@@ -277,9 +277,11 @@ def test_wave_sharded_hlo_reduce_scatters_once_per_wave(rng):
     learner = ShardedWaveLearner(Config.from_params(params),
                                  ds.constructed, make_mesh())
     hlo = learner.lowered_hlo_text()
+    # anchor to DEFINING instructions ("... = f32[dims] ... reduce-scatter(")
+    # so consumer ops referencing a reduce-scatter operand don't count
     shapes = [tuple(int(x) for x in m.group(1).split(","))
-              for m in re.finditer(r"f32\[([\d,]+)\][^\n]*reduce-scatter",
-                                   hlo)]
+              for m in re.finditer(
+                  r"= f32\[([\d,]+)\][^\n]*? reduce-scatter\(", hlo)]
     assert shapes, "no reduce-scatter in the lowered HLO"
     # the batched once-per-wave exchange: leading dim == the wave width
     # (the full-width body and/or the W=8 ramp body)
