@@ -84,8 +84,12 @@ def main():
         }))
         return
 
-    ips_1m = run_scale(1_000_000, 10)
-    ips_full = run_scale(10_500_000, 5)
+    # the reference's Higgs number times 500 iterations end-to-end; the
+    # axon tunnel's flat ~105 ms device->host sync lands ONCE per timed
+    # loop, so more steady-state iterations = closer to the reference's
+    # methodology (at 10 iters the artifact alone was ~10.5 ms/iter, ~8%)
+    ips_1m = run_scale(1_000_000, 30)
+    ips_full = run_scale(10_500_000, 6)
     print(json.dumps({
         "metric": "boosting iters/sec (synthetic Higgs-like 1Mx28, "
                   "255 leaves, 255 bins; _10p5m = reference row count)",

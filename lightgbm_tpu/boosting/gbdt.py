@@ -310,16 +310,17 @@ class GBDT:
         if not pend:
             return
         self._pending = []
-        # ONE device stack + 3 fetches for the whole batch instead of 3
-        # fetches per tree: a device->host fetch costs ~105 ms on the axon
-        # tunnel, so per-tree fetching made a 16-iteration flush cost ~5 s
-        rf_all = np.asarray(jnp.stack([p[1] for p in pend]))
-        ri_all = np.asarray(jnp.stack([p[2] for p in pend]))
-        rc_all = np.asarray(jnp.stack([p[3] for p in pend]))
+        # the record arrays were copy_to_host_async'd at dispatch time, so
+        # these np.asarray calls find host-resident data (~0.2 ms each);
+        # only records of still-executing queued trees block, on execution
+        # itself.  (A cold fetch costs ~105 ms flat on the axon tunnel —
+        # the earlier stack+3-fetch flush paid ~0.3 s plus a first-call
+        # compile; per-tree cold fetches would cost ~5 s per flush.)
         first_idx = len(self._models)
-        for k2, (idx, _rf, _ri, _rc, init_sc) in enumerate(pend):
-            tree = self.learner.assemble_host(rf_all[k2], ri_all[k2],
-                                              rc_all[k2])
+        for idx, rf, ri, rc, init_sc in pend:
+            tree = self.learner.assemble_host(np.asarray(rf),
+                                              np.asarray(ri),
+                                              np.asarray(rc))
             if tree.num_leaves > 1:
                 tree.apply_shrinkage(self.shrinkage_rate)
                 if abs(init_sc) > kEpsilon:
@@ -571,6 +572,12 @@ class GBDT:
             self.train_score.score, self.learner.bins_packed(),
             self._bag_mask, fmask, self._lr_dev)
         self.train_score.score = score
+        # start the device->host record copies NOW: they stream behind the
+        # still-queued tree programs, so the 16-iteration flush finds them
+        # host-resident (a cold fetch costs ~105 ms flat on the axon
+        # tunnel; pre-copied ~0.2 ms — profiling/probe_async_fetch.py)
+        for a in (rec_f, rec_i, rec_cat):
+            a.copy_to_host_async()
         self._pending.append((len(self._models), rec_f, rec_i, rec_cat,
                               init_scores[0]))
         self._models.append(None)
@@ -601,6 +608,8 @@ class GBDT:
                                          fmask)
             self.train_score.score = _score_add_leaf(
                 self.train_score.score, leaf_out, leaf_id, self._lr_dev, k)
+            for a in (rec_f, rec_i, rec_cat):  # see _train_trees_fused
+                a.copy_to_host_async()
             self._pending.append((len(self._models), rec_f, rec_i, rec_cat,
                                   init_scores[k]))
             self._models.append(None)

@@ -337,12 +337,12 @@ class Config:
     tpu_wave_max_bytes: int = 1 << 32
     # speculative growth overshoot as a fraction of (num_leaves - 1):
     # extra bottom waves pre-split the leaves the exact greedy replay will
-    # want, trading extra (cheap-at-small-N) waves for expensive replay
-    # stalls.  The optimum is SCALE-DEPENDENT (round-5 sweeps on v5e,
-    # after sort-deferral): 0.65-0.75 wins at 1M rows (-12 ms/tree vs
-    # 0.25) but 0.25 wins at 10.5M (extra waves' full-array passes scale
-    # with N while stall windows don't).  -1 = auto: 0.7 up to 2M local
-    # rows, 0.25 above
+    # want, trading extra waves (full-array passes, ∝N) for replay
+    # stalls.  With batched mask-mode stall corrections (stall_batch > 1,
+    # the default) stalls are cheap enough that 0 wins at every measured
+    # scale (v5e round 5: 9.28 vs 8.05 it/s at 1M, 0.854 vs 0.770 at
+    # 10.5M); -1 = auto: 0.0 when stall_batch > 1, else the round-4
+    # scale-dependent optimum (0.7 up to 2M local rows, 0.25 above)
     tpu_wave_overshoot: float = -1.0
     # wave members whose window is at or below this size split in place
     # (lid-lane rewrite, children share the parent span) instead of joining
@@ -365,6 +365,16 @@ class Config:
     # both levels.  Halves the number of full-array sorts — the wave
     # learner's largest per-wave cost (~6 ms each on v5e at 1M rows)
     tpu_wave_defer_sorts: bool = True
+    # replay stall correction batch: when the exact greedy replay reaches
+    # a leaf the speculative growth never split, split up to this many of
+    # the highest-priority unsplit frontier leaves in ONE correction pass
+    # (one batched bookkeeping/scan, one sim re-entry) instead of one
+    # re-entry per miss.  Extra members are speculative the same way the
+    # growth overshoot is — the replay pops exactly (num_leaves - 1)
+    # splits regardless — and the slot/pool sizing already reserves
+    # (num_leaves - 1) correction splits, so a guard stops batching near
+    # that reserve.  1 = the round-4 one-miss-per-pass behavior
+    tpu_wave_stall_batch: int = 4
 
     # derived (not user-settable)
     is_parallel: bool = field(default=False, repr=False)

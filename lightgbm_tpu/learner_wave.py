@@ -62,15 +62,40 @@ from .ops.lookup import lookup_int
 _HIGH = lax.Precision.HIGHEST
 
 
+def _stall_extras_cap(budget: int) -> int:
+    """Cap on speculative batch EXTRAS (members beyond the sim's stalled
+    top) across the whole replay — a dedicated counter in the replay loop
+    enforces it, so the slot/pool reserve stays tight."""
+    return min(budget - 1, 64)
+
+
+def _correction_reserve(cfg: Config, budget: int) -> int:
+    """Worst-case replay correction splits, for slot/hist-pool sizing.
+
+    Every stalled TOP maps to a distinct pop, so tops <= budget; batch
+    extras (stall_batch > 1) are counted separately in the replay loop
+    and capped at ``_stall_extras_cap``.  Shared by ``_init_wave_dims``
+    and ``wave_budget_reason`` so the formulas cannot drift."""
+    k = max(1, min(int(getattr(cfg, "tpu_wave_stall_batch", 4)), 16))
+    return budget if k == 1 else budget + _stall_extras_cap(budget)
+
+
 def _resolve_overshoot(cfg: Config, local_rows: int) -> float:
-    """Scale-aware auto for ``tpu_wave_overshoot`` (see config.py): the
-    extra speculative waves' full-array passes cost ∝N while the replay
-    stalls they prevent cost ~window-sized work, so the optimum drops as
-    the (local) row count grows — measured 0.7 at 1M vs 0.25 at 10.5M on
-    v5e."""
+    """Auto for ``tpu_wave_overshoot`` (see config.py).
+
+    With batched mask-mode replay corrections (``tpu_wave_stall_batch`` >
+    1, the default) a speculation miss costs ~window-sized work amortized
+    over K members, so buying misses down with extra speculative waves —
+    whose full-array passes cost ∝N — no longer pays AT ANY SCALE:
+    overshoot 0 wins (v5e: 9.28 vs 8.05 it/s at 1M, 0.854 vs 0.770 at
+    10.5M).  The single-miss-per-pass path (stall_batch=1) keeps the
+    round-4 scale-dependent optimum (0.7 at 1M, 0.25 at 10.5M)."""
     ov = float(cfg.tpu_wave_overshoot)
     if ov < 0:
-        ov = 0.7 if local_rows <= 2_000_000 else 0.25
+        if int(getattr(cfg, "tpu_wave_stall_batch", 4)) > 1:
+            ov = 0.0
+        else:
+            ov = 0.7 if local_rows <= 2_000_000 else 0.25
     return ov
 
 
@@ -142,7 +167,7 @@ class WaveTPUTreeLearner(CompactTPUTreeLearner):
         cheap (small windows freeze — no sort).  The replay still pops
         exactly ``budget`` splits, so the tree is unchanged.  Slot/pool
         sizing makes overflow impossible: growth performs <= grow_budget
-        splits, the replay correction <= budget more."""
+        splits, the replay correction <= ``_correction_reserve`` more."""
         self.budget = self.num_leaves - 1
         self.W = max(1, min(int(cfg.tpu_wave_width), self.budget))
         try:
@@ -170,8 +195,13 @@ class WaveTPUTreeLearner(CompactTPUTreeLearner):
         self.open_levels = max(0, min(ol, (self.budget + 1).bit_length() - 1))
         # sort-deferral alternation (Config.tpu_wave_defer_sorts)
         self._defer_sorts = bool(getattr(cfg, "tpu_wave_defer_sorts", True))
-        self.M = 1 + 2 * (self.grow_budget + self.budget)
-        self.H = self.grow_budget + self.budget + 2
+        # replay stall-correction batch width (Config.tpu_wave_stall_batch)
+        self._stall_batch = max(
+            1, min(int(getattr(cfg, "tpu_wave_stall_batch", 4)), 16))
+        self._extras_cap = _stall_extras_cap(self.budget)
+        corr = _correction_reserve(cfg, self.budget)
+        self.M = 1 + 2 * (self.grow_budget + corr)
+        self.H = self.grow_budget + corr + 2
         # row-chunk bound for the per-row mask contractions: bounds the
         # (rows, W) transients to ~256 MB at any N (lax.map'd above it)
         self._row_chunk = 1 << 20
@@ -792,6 +822,44 @@ class WaveTPUTreeLearner(CompactTPUTreeLearner):
 
     # -- the stall split (exact-replay correction) ---------------------------
 
+    def _span_decide(self, bw, ww, lid, off, c, leaf, feat, thr, dleft,
+                     is_cat, cat_bits):
+        """Per-row split decision over one sliced window — the decode
+        (bin-word extraction, EFB un-bundling, missing-value routing,
+        categorical bitset) shared by the K=1 sort/frozen stall partition
+        and the batched mask-mode one, so a routing fix cannot
+        desynchronize them.  Returns (in_seg, go_left, lc_bag, c_bag)."""
+        S = lid.shape[0]
+        pos = jnp.arange(S, dtype=jnp.int32)
+        in_seg = (pos >= off) & (pos < off + c) & (lid == leaf)
+        col = self.fw_col[feat]
+        word = lax.dynamic_slice(bw, (col // 4, jnp.int32(0)), (1, S))[0]
+        code = (word >> ((col % 4) * 8)) & 0xFF
+        if self._bundle is not None:
+            boffk = self.fw_goff[feat]
+            d = self.f_default_bin[feat]
+            r = code - boffk
+            in_r = (r >= 0) & (r < self.f_num_bin[feat] - 1)
+            dec = r + (r >= d).astype(r.dtype)
+            frow = jnp.where(self.fw_bnd[feat] == 1,
+                             jnp.where(in_r, dec, d), code)
+        else:
+            frow = code
+        mtk = self.f_missing[feat]
+        dbk = self.f_default_bin[feat]
+        nbk = self.f_num_bin[feat]
+        is_missing = ((mtk == MISSING_ZERO) & (frow == dbk)) | \
+                     ((mtk == MISSING_NAN) & (frow == nbk - 1))
+        go_left = jnp.where(is_missing, dleft, frow <= thr)
+        if self.has_categorical:
+            cat_left = (cat_bits[frow >> 5]
+                        >> (frow & 31).astype(jnp.uint32)) & 1
+            go_left = jnp.where(is_cat, cat_left == 1, go_left)
+        bag = ww[2] > 0.5
+        lc_bag = jnp.sum(in_seg & go_left & bag, dtype=jnp.int32)
+        c_bag = jnp.sum(in_seg & bag, dtype=jnp.int32)
+        return in_seg, go_left, lc_bag, c_bag
+
     def _make_stall_branch(self, S: int, sort_mode: bool):
         """Partition of one window outside the wave flow, mirroring the
         sequential compact learner exactly (`learner_compact.py`
@@ -814,34 +882,10 @@ class WaveTPUTreeLearner(CompactTPUTreeLearner):
             ww = lax.dynamic_slice(w_p, (jnp.int32(0), sa), (3, S))
             lid = lax.dynamic_slice(lid_p, (sa,), (S,))
             pos = jnp.arange(S, dtype=jnp.int32)
-            in_seg = (pos >= off) & (pos < off + c) & (lid == leaf)
-            col = self.fw_col[feat]
-            word = lax.dynamic_slice(bw, (col // 4, jnp.int32(0)), (1, S))[0]
-            code = (word >> ((col % 4) * 8)) & 0xFF
-            if self._bundle is not None:
-                boffk = self.fw_goff[feat]
-                d = self.f_default_bin[feat]
-                r = code - boffk
-                in_r = (r >= 0) & (r < self.f_num_bin[feat] - 1)
-                dec = r + (r >= d).astype(r.dtype)
-                frow = jnp.where(self.fw_bnd[feat] == 1,
-                                 jnp.where(in_r, dec, d), code)
-            else:
-                frow = code
-            mtk = self.f_missing[feat]
-            dbk = self.f_default_bin[feat]
-            nbk = self.f_num_bin[feat]
-            is_missing = ((mtk == MISSING_ZERO) & (frow == dbk)) | \
-                         ((mtk == MISSING_NAN) & (frow == nbk - 1))
-            go_left = jnp.where(is_missing, dleft, frow <= thr)
-            if self.has_categorical:
-                cat_left = (cat_bits[frow >> 5]
-                            >> (frow & 31).astype(jnp.uint32)) & 1
-                go_left = jnp.where(is_cat, cat_left == 1, go_left)
-            bag = ww[2] > 0.5
+            in_seg, go_left, lc_bag, c_bag = self._span_decide(
+                bw, ww, lid, off, c, leaf, feat, thr, dleft, is_cat,
+                cat_bits)
             segl = in_seg & go_left
-            lc_bag = jnp.sum((segl & bag).astype(jnp.int32))
-            c_bag = jnp.sum((in_seg & bag).astype(jnp.int32))
             if sort_mode:
                 rid = lax.dynamic_slice(rid_p, (sa,), (S,))
                 key = jnp.where(in_seg,
@@ -873,7 +917,8 @@ class WaveTPUTreeLearner(CompactTPUTreeLearner):
         return branch
 
     def _stall_split(self, st: WaveState, top, feature_mask) -> WaveState:
-        """Split one frontier leaf outside the wave flow."""
+        """Split one frontier leaf outside the wave flow (the
+        ``tpu_wave_stall_batch=1`` replay path)."""
         crow_i = st.cand_i[top]
         feat = crow_i[CI_FEAT]
         thr = crow_i[CI_THR]
@@ -916,6 +961,114 @@ class WaveTPUTreeLearner(CompactTPUTreeLearner):
             lc_bag[None], c_bag[None], li, ri, ph[None], rh[None],
             jnp.stack([hl, hr]), feature_mask)
 
+    def _make_stall_mask_branch(self, S: int):
+        """Lid-only partition of one covering span for the batched replay
+        correction.  No row moves: children share the parent's span the
+        way frozen (sub-cutoff) wave windows already do, so the
+        surrounding fori_loop carries ONLY the lid lane.  (A first cut
+        carried bins/weights/rid/pool through the loop; XLA could not
+        alias the carries past their other consumers and inserted ~7 ms
+        full-array copies per stall event — the copies, not the splits,
+        dominated the replay.)"""
+        fw, n = self.fw, self._rows_len()
+
+        def branch(bins_p, w_p, lid_p, s, c, leaf, feat, thr, dleft,
+                   is_cat, cat_bits, l0, r0):
+            sa = jnp.clip(s, 0, n - S).astype(jnp.int32)
+            off = (s - sa).astype(jnp.int32)
+            bw = lax.dynamic_slice(bins_p, (jnp.int32(0), sa), (fw, S))
+            ww = lax.dynamic_slice(w_p, (jnp.int32(0), sa), (3, S))
+            lid = lax.dynamic_slice(lid_p, (sa,), (S,))
+            in_seg, go_left, lc_bag, c_bag = self._span_decide(
+                bw, ww, lid, off, c, leaf, feat, thr, dleft, is_cat,
+                cat_bits)
+            lid2 = jnp.where(in_seg, jnp.where(go_left, l0, r0), lid)
+            lid_p = lax.dynamic_update_slice(lid_p, lid2, (sa,))
+            return lid_p, lc_bag, c_bag
+
+        return branch
+
+    def _stall_split_batch(self, st: WaveState, tops, bvalid,
+                           feature_mask) -> WaveState:
+        """Split up to K frontier leaves in ONE replay correction pass.
+
+        Availability advances only by pops (a split never reveals its
+        node to the sim), so members beyond the sim's exact-priority top
+        are speculation exactly like the growth overshoot: the replay
+        still pops exactly ``budget`` splits in the reference's best-first
+        order (`serial_tree_learner.cpp:185-218`), and an unused member
+        costs one wasted lid-mask partition while a used one saves a whole
+        stall (priority sort + sim re-entry + single correction).  The
+        members are distinct frontier leaves with disjoint rows, so the
+        sequential lid rewrites commute; bookkeeping and the child split
+        scans run ONCE, batched over all members."""
+        K = tops.shape[0]
+        OOBH = jnp.int32(self.H + 7)
+        bv_i = bvalid.astype(jnp.int32)
+        pos = jnp.cumsum(bv_i) - bv_i
+        l0s = (st.num_nodes + 2 * pos).astype(jnp.int32)
+        r0s = l0s + 1
+        phs = st.hslot[tops]
+        rhs = (1 + st.num_splits + pos).astype(jnp.int32)
+        h_t = st.hist_pool[0]
+        bins_p, w_p = st.bins_p, st.w_p   # read-only: no rows move
+        # MATERIALIZED covering spans: for a child deferred by sort
+        # alternation, node_i holds its logical (post-sort) window but the
+        # rows physically sit in the parent's span — phys_i tracks that,
+        # which also lets the growth loop skip the pre-replay
+        # materialization sort entirely
+        spans = st.phys_i[tops]           # (K, 2)
+        acc0 = (st.lid_p, jnp.zeros(K, jnp.int32), jnp.zeros(K, jnp.int32),
+                jnp.zeros((K, 2) + h_t.shape, h_t.dtype))
+
+        def body(i, carry):
+            lid_p, lc_a, c_a, h2_a = carry
+            top = tops[i]
+            ok = bvalid[i]
+            crow_i = st.cand_i[top]
+            feat = crow_i[CI_FEAT]
+            thr = crow_i[CI_THR]
+            dleft = (crow_i[CI_FLAGS] & 1) == 1
+            is_cat = (crow_i[CI_FLAGS] & 2) == 2
+            cat_bits = st.cand_b[top]
+            s = spans[i, 0]
+            # an invalid member degrades to a zero-row no-op in the
+            # smallest bucket; all writes below are masked or dropped
+            c = jnp.where(ok, spans[i, 1], 0)
+            pidx = self._bucket_idx(jnp.maximum(c, 1))
+            lid_p, lc_bag, c_bag = lax.switch(
+                pidx, self._stall_mask_branches, bins_p, w_p, lid_p, s, c,
+                top, feat, thr, dleft, is_cat, cat_bits, l0s[i], r0s[i])
+            lc_bag, c_bag = self._sync_counts(lc_bag, c_bag)
+            # smaller-child histogram over the span with a lid mask;
+            # sibling by subtraction from the parent's pooled histogram
+            left_small = lc_bag <= (c_bag - lc_bag)
+            sm_slot = jnp.where(left_small, l0s[i], r0s[i])
+            h_small = self._reduce_hist(
+                lax.switch(pidx, self._hist_branches, bins_p, w_p, lid_p,
+                           s, c, sm_slot))
+            h_par = st.hist_pool[phs[i]]
+            h_large = h_par - h_small
+            hl = jnp.where(left_small, h_small, h_large)
+            hr = jnp.where(left_small, h_large, h_small)
+            lc_a = lc_a.at[i].set(lc_bag)
+            c_a = c_a.at[i].set(c_bag)
+            h2_a = h2_a.at[i, 0].set(hl).at[i, 1].set(hr)
+            return (lid_p, lc_a, c_a, h2_a)
+
+        lid_p, lc_a, c_a, h2_a = lax.fori_loop(0, K, body, acc0)
+        hists2 = h2_a.reshape((2 * K,) + h_t.shape)
+        # ONE masked pool write outside the loop (the pool never rides
+        # the loop carry)
+        i2 = jnp.stack([jnp.where(bvalid, phs, OOBH),
+                        jnp.where(bvalid, rhs, OOBH)], 1).reshape(-1)
+        st = st._replace(
+            lid_p=lid_p,
+            hist_pool=st.hist_pool.at[i2].set(hists2, mode="drop"))
+        return self._children_bookkeeping(
+            st, tops, bvalid, l0s, r0s, lc_a, c_a, spans, spans, phs, rhs,
+            hists2, feature_mask)
+
     # -- exact greedy replay --------------------------------------------------
 
     def _replay(self, st: WaveState, feature_mask):
@@ -939,6 +1092,9 @@ class WaveTPUTreeLearner(CompactTPUTreeLearner):
 
         The OUTER loop — one iteration per speculation miss, usually zero
         total — re-enters after performing a missing split."""
+        if self._stall_batch > 1:
+            self._stall_mask_branches = [self._make_stall_mask_branch(S)
+                                         for S in self._win_sizes]
         M, budget = self.M, self.budget
         OOB = jnp.int32(M + 7)
         NEG = jnp.finfo(jnp.float32).min
@@ -947,7 +1103,8 @@ class WaveTPUTreeLearner(CompactTPUTreeLearner):
             return carry[-1] == 0  # 0 = need (another) sim pass
 
         def outer_body(carry):
-            st, avail_n, refidx, pops, leaf_cnt, poprec, stalls, _ = carry
+            (st, avail_n, refidx, pops, leaf_cnt, poprec, stalls, extras,
+             _) = carry
             gains = st.cand_f[:, CF_GAIN].astype(self._acc)
             split_m = st.split_m
             child0 = st.child0
@@ -1038,15 +1195,51 @@ class WaveTPUTreeLearner(CompactTPUTreeLearner):
                  jnp.asarray(0, jnp.int32), jnp.asarray(0, jnp.int32)))
             avail_n, refidx, pops, leaf_cnt, poprec, flag, top = ic
 
-            def do_stall(s):
-                # the stalled node is now split; it stays available with
-                # its (unchanged) gain — the next pass pops it
-                return self._stall_split(s, top, feature_mask)
+            Kb = self._stall_batch
+            if Kb == 1:
+                def do_stall1(s):
+                    return self._stall_split(s, top, feature_mask), \
+                        jnp.int32(1)
 
-            st = lax.cond(flag == 1, do_stall, lambda s: s, st)
+                st, nsp = lax.cond(flag == 1, do_stall1,
+                                   lambda s: (s, jnp.int32(0)), st)
+                return (st, avail_n, refidx, pops, leaf_cnt, poprec,
+                        stalls + nsp, extras,
+                        jnp.where(flag == 1, jnp.int32(0), flag))
+
+            def do_stall(s):
+                # split the top-Kb REPLAY-PRIORITY (gain desc, refidx asc)
+                # available unsplit leaves at once.  The first is provably
+                # the sim's stalled top — flag==1 means the min-refidx
+                # max-gain available node is unsplit, and restricting the
+                # min to the unsplit subset it belongs to can't change it —
+                # so it stays available with its unchanged gain and the
+                # next pass pops it; later members are the likeliest
+                # upcoming stalls
+                cand_u = avail_n & ~s.split_m & (gains > 0.0)
+                gk = jnp.where(cand_u, -gains, jnp.inf)
+                rk = jnp.where(cand_u, refidx, jnp.int32(1 << 30))
+                _, _, osel = lax.sort([gk, rk, iota], num_keys=2,
+                                      is_stable=True)
+                tops_k = osel[:Kb]
+                bv = cand_u[tops_k]
+                # EXTRAS (members beyond the top) count against the
+                # dedicated _stall_extras_cap reserve; the top itself is
+                # always safe — each top maps to a distinct pop, which the
+                # budget-sized share of the reserve covers
+                head = (extras + jnp.arange(-1, Kb - 1, dtype=jnp.int32)) \
+                    < jnp.int32(self._extras_cap)
+                bv = bv & (head | (jnp.arange(Kb) == 0))
+                s2 = self._stall_split_batch(s, tops_k, bv, feature_mask)
+                nsp = jnp.sum(bv, dtype=jnp.int32).astype(jnp.int32)
+                return s2, nsp, nsp - bv[0].astype(jnp.int32)
+
+            st, nsp, nex = lax.cond(
+                flag == 1, do_stall,
+                lambda s: (s, jnp.int32(0), jnp.int32(0)), st)
             # stall -> another sim pass (flag back to 0); done stays 2
             return (st, avail_n, refidx, pops, leaf_cnt, poprec,
-                    stalls + (flag == 1).astype(jnp.int32),
+                    stalls + nsp, extras + nex,
                     jnp.where(flag == 1, jnp.int32(0), flag))
 
         avail0 = jnp.zeros(M, bool).at[0].set(True)
@@ -1056,9 +1249,10 @@ class WaveTPUTreeLearner(CompactTPUTreeLearner):
                 jnp.asarray(1, jnp.int32),
                 jnp.zeros((budget, 2), jnp.int32),
                 jnp.asarray(0, jnp.int32),
+                jnp.asarray(0, jnp.int32),
                 jnp.asarray(0, jnp.int32))
-        st, avail_n, refidx, pops, leaf_cnt, poprec, stalls, _ = \
-            lax.while_loop(outer_cond, outer_body, init)
+        (st, avail_n, refidx, pops, leaf_cnt, poprec, stalls, _extras,
+         _) = lax.while_loop(outer_cond, outer_body, init)
         pop_nodes, pop_ref = poprec[:, 0], poprec[:, 1]
         # final frontier = revealed (root or child of a popped node) and
         # never popped — reconstructed from the pop list
@@ -1097,9 +1291,11 @@ class WaveTPUTreeLearner(CompactTPUTreeLearner):
 
         st = lax.while_loop(gcond, lambda s: self._wave_step(s, feature_mask),
                             st)
-        if self._defer_sorts:
-            # the growth loop may exit on a deferring wave — the replay's
-            # stall splits slice PHYSICAL windows, so materialize first
+        if self._defer_sorts and self._stall_batch == 1:
+            # the growth loop may exit on a deferring wave — the K=1
+            # replay's stall splits slice PHYSICAL windows, so materialize
+            # first.  Batched (K>1) corrections mask through phys_i
+            # covering spans instead, so they skip this sort
             st = lax.cond(st.pending, self._materialize_sort,
                           lambda s: s, st)
         return self._emit_tree_wave(st, feature_mask)
@@ -1187,8 +1383,9 @@ def wave_budget_reason(cfg: Config, n_pad: int, f_pad: int, b: int
     grow = min(budget + int(np.ceil(budget
                                     * _resolve_overshoot(cfg, n_pad))),
                2 * budget)
-    M = 1 + 2 * (grow + budget)
-    h_bytes = (grow + budget + 2) * f_pad * b * 3 * 4
+    corr = _correction_reserve(cfg, budget)
+    M = 1 + 2 * (grow + corr)
+    h_bytes = (grow + corr + 2) * f_pad * b * 3 * 4
     scan_bytes = 2 * W * f_pad * b * 3 * 4
     # per-wave transients (round-3 advisor): the (rows, W) f32 wave-member
     # mask is CHUNKED to 2^20 rows (lax.map in _wave_body) and the

@@ -128,7 +128,9 @@ class ShardedWaveLearner(ShardedCompactLearner, WaveTPUTreeLearner):
 
         st = lax.while_loop(gcond,
                             lambda s: self._wave_step(s, fmask_pad), st)
-        if self._defer_sorts:
+        if self._defer_sorts and self._stall_batch == 1:
+            # batched (K>1) replay corrections mask through phys_i spans
+            # and skip the pre-replay materialization (see learner_wave)
             st = lax.cond(st.pending, self._materialize_sort,
                           lambda s: s, st)
         return self._emit_tree_wave(st, fmask_pad)

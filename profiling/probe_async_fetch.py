@@ -1,0 +1,67 @@
+"""Does copy_to_host_async() hide the axon tunnel's ~105 ms fetch?
+
+Three timings on small (tree-record-sized) device arrays:
+  A. cold np.asarray                       — expect ~105 ms (tunnel RTT)
+  B. copy_to_host_async + wait + asarray   — ~0 ms if async copies work
+  C. 48 pre-copied arrays fetched serially — the full 16-tree flush shape
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+
+def main():
+    mk = jax.jit(lambda i: (jnp.ones((254, 17), jnp.float32) * i,
+                            jnp.ones((254, 2), jnp.int32) + i,
+                            jnp.zeros((254, 8), jnp.uint32)))
+    arrs = []
+    for i in range(16):
+        t = mk(i)
+        arrs.extend(t)
+    jax.block_until_ready(arrs)
+    np.asarray(arrs[0])  # force one real sync
+
+    # A: cold fetch of one small array
+    f, _, _ = mk(99)
+    t0 = time.perf_counter()
+    np.asarray(f)
+    print(f"A cold asarray: {(time.perf_counter() - t0) * 1e3:.1f} ms")
+
+    # B: async copy then fetch
+    f2, _, _ = mk(123)
+    f2.copy_to_host_async()
+    time.sleep(0.4)
+    t0 = time.perf_counter()
+    np.asarray(f2)
+    print(f"B pre-copied asarray: {(time.perf_counter() - t0) * 1e3:.1f} ms")
+
+    # C: 48 pre-copied arrays, serial fetch
+    for a in arrs:
+        a.copy_to_host_async()
+    time.sleep(0.8)
+    t0 = time.perf_counter()
+    for a in arrs:
+        np.asarray(a)
+    print(f"C 48 pre-copied fetches: {(time.perf_counter() - t0) * 1e3:.1f} ms total")
+
+    # D: 48 cold fetches (the disaster case the stack+3-fetch design avoids)
+    arrs2 = []
+    for i in range(16):
+        arrs2.extend(mk(1000 + i))
+    jax.block_until_ready(arrs2)
+    t0 = time.perf_counter()
+    for a in arrs2:
+        np.asarray(a)
+    print(f"D 48 cold fetches: {(time.perf_counter() - t0) * 1e3:.1f} ms total")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,67 @@
+"""Quick tunnel/chip health probe: dispatch latency, fetch latency, MXU rate.
+
+Compare with PROFILE.md's constants (dispatch ~2.5 ms async, fetch ~105 ms
+flat, bf16 matmul near peak).  Run when bench numbers look off to tell a
+degraded tunnel from a real code regression.
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+
+def main():
+    dev = jax.devices()[0]
+    print("device:", dev)
+    x = jnp.ones((8, 128), jnp.float32)
+    f = jax.jit(lambda x: x * 1.0001)
+    y = f(x); np.asarray(y[0, 0])
+    # dispatch: N async tiny ops, no fetch until the end
+    for n in (50,):
+        t0 = time.perf_counter()
+        y = x
+        for _ in range(n):
+            y = f(y)
+        np.asarray(y[0, 0])
+        dt = time.perf_counter() - t0
+        print(f"chained tiny dispatch x{n}: {dt / n * 1e3:.2f} ms/op")
+    # fetch: single scalar fetch
+    for _ in range(3):
+        t0 = time.perf_counter()
+        np.asarray(y[0, 0])
+        print(f"scalar fetch: {(time.perf_counter() - t0) * 1e3:.1f} ms")
+    # MXU: bf16 4k matmul
+    a = jnp.ones((4096, 4096), jnp.bfloat16)
+    mm = jax.jit(lambda a: a @ a)
+    b = mm(a); np.asarray(b[0, 0].astype(jnp.float32))
+    t0 = time.perf_counter()
+    b = a
+    for _ in range(10):
+        b = mm(b)
+    np.asarray(b[0, 0].astype(jnp.float32))
+    dt = (time.perf_counter() - t0) / 10
+    print(f"4k bf16 matmul: {dt * 1e3:.2f} ms  "
+          f"({2 * 4096 ** 3 / dt / 1e12:.1f} TFLOP/s)")
+    # HBM: big elementwise copy-add
+    c = jnp.ones((64, 1 << 20), jnp.float32)   # 256 MB
+    ew = jax.jit(lambda c: c + 1.0)
+    d = ew(c); np.asarray(d[0, 0])
+    t0 = time.perf_counter()
+    d = c
+    for _ in range(10):
+        d = ew(d)
+    np.asarray(d[0, 0])
+    dt = (time.perf_counter() - t0) / 10
+    print(f"256MB elementwise: {dt * 1e3:.2f} ms  "
+          f"({2 * c.nbytes / dt / 1e9:.0f} GB/s)")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,68 @@
+"""Sweep the replay stall-correction batch width x growth overshoot.
+
+Usage: python profiling/profile_stall_batch.py [ROWS] [ITERS] [K,K,...] [OV,OV,...]
+
+The bench workload at ROWS rows, steady-state iters/sec per (K, overshoot)
+cell.  Run ALONE on the chip — the replay section is dispatch-bound and a
+concurrent compile storm on the host skews it badly.
+"""
+
+import gc
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def run(rows, iters, k, ov, warmup=2):
+    import lightgbm_tpu as lgb
+
+    rng = np.random.RandomState(7)
+    f = 28
+    X = rng.randn(rows, f).astype(np.float64)
+    logit = (X[:, 0] * 1.5 + X[:, 1] * X[:, 2] * 0.5 + np.sin(X[:, 3])
+             + 0.5 * rng.randn(rows))
+    y = (logit > 0).astype(np.float64)
+    params = {"objective": "binary", "num_leaves": 255, "max_bin": 255,
+              "learning_rate": 0.1, "min_data_in_leaf": 20,
+              "verbosity": -1, "metric": "none",
+              "tpu_wave_stall_batch": k}
+    if ov is not None:
+        params["tpu_wave_overshoot"] = ov
+    ds = lgb.Dataset(X, label=y, params=params)
+    bst = lgb.Booster(params, ds)
+    sync = lambda: float(np.asarray(bst.gbdt.train_score.score[0, 0]))
+    for _ in range(warmup):
+        bst.update()
+    sync()
+    t0 = time.time()
+    for _ in range(iters):
+        bst.update()
+    sync()
+    dt = time.time() - t0
+    del bst, ds, X, y
+    gc.collect()
+    return iters / dt
+
+
+def main():
+    rows = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
+    iters = int(sys.argv[2]) if len(sys.argv) > 2 else 15
+    ks = [int(t) for t in (sys.argv[3].split(",") if len(sys.argv) > 3
+                           else ["1", "4", "8"])]
+    ovs = [None if t == "auto" else float(t)
+           for t in (sys.argv[4].split(",") if len(sys.argv) > 4
+                     else ["auto"])]
+    for ov in ovs:
+        for k in ks:
+            ips = run(rows, iters, k, ov)
+            print(f"rows={rows} overshoot={ov if ov is not None else 'auto'} "
+                  f"stall_batch={k}: {ips:.4f} it/s "
+                  f"({1000.0 / ips:.1f} ms/iter)", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -50,10 +50,16 @@ def _pair(**over):
     # canonical (leaf-compacted) row order at every step, while opening
     # sums the first levels' histograms in ROOT row order — same splits,
     # last-ulp f32 differences (dedicated opening tests below)
+    # stall_batch=1 for the same reason: batched (K>1) replay corrections
+    # histogram the stalled leaf through its parent's covering span with a
+    # lid mask (parent row order) instead of a compacted child window —
+    # same rows, last-ulp f32 summation differences (dedicated tolerance
+    # test below)
     base = {"objective": "binary", "num_leaves": 31, "max_bin": 63,
             "min_data_in_leaf": 20, "verbosity": -1, "metric": "none",
             "tpu_sort_cutoff": 0, "tpu_wave_sort_cutoff": 0,
-            "tpu_wave_open_levels": 0, "tpu_wave_defer_sorts": False}
+            "tpu_wave_open_levels": 0, "tpu_wave_defer_sorts": False,
+            "tpu_wave_stall_batch": 1}
     base.update(over)
     return dict(base, tpu_learner="compact"), dict(base, tpu_learner="wave")
 
@@ -79,6 +85,23 @@ def test_wave_default_cutoff_tolerance():
     for p in (pa, pb):
         del p["tpu_sort_cutoff"], p["tpu_wave_sort_cutoff"]
     _models_equal(pa, pb, X, y, exact=False)
+
+
+@pytest.mark.parametrize("defer", [False, True])
+def test_wave_stall_batch_tolerance(defer):
+    # batched replay corrections (the tpu_wave_stall_batch=4 default) mask
+    # the stalled leaf's histogram through its parent's span instead of a
+    # compacted window — same split structure, float-level value drift;
+    # low overshoot forces plenty of stalls so the batch path really runs.
+    # defer=True covers the SHIPPED default combination, where batched
+    # corrections read phys_i covering spans of sort-deferred children and
+    # the pre-replay materialization sort is skipped
+    X, y = _make()
+    _, pb = _pair(tpu_wave_overshoot=0.0, tpu_wave_defer_sorts=defer)
+    pb2 = dict(pb, tpu_wave_stall_batch=4)
+    del pb2["tpu_sort_cutoff"], pb2["tpu_wave_sort_cutoff"]
+    del pb["tpu_sort_cutoff"], pb["tpu_wave_sort_cutoff"]
+    _models_equal(pb, pb2, X, y, exact=False)
 
 
 def test_wave_bagging_feature_fraction():
