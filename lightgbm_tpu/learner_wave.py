@@ -751,19 +751,27 @@ class WaveTPUTreeLearner(CompactTPUTreeLearner):
                            phys_i=st.node_i, pending=jnp.asarray(False))
 
     def _segment_hists(self, st: WaveState, sm_slot, sm_start, sm_cnt,
-                       valid):
+                       valid, t_cap: Optional[int] = None):
         """Smaller-child histograms for every wave member in ONE Pallas
         call (`ops/hist_pallas.py:build_histogram_segments`): the chunk
         list walks each member's row-blocks; rows are masked by lid so
         block alignment never matters.  Invalid members get one all-masked
-        chunk so their output slot is defined (zeros)."""
+        chunk so their output slot is defined (zeros).
+
+        ``t_cap`` overrides the chunk-capacity bound for callers whose
+        members don't satisfy the wave invariants (the batched replay
+        correction: members may share large un-materialized covering
+        spans, so its cap is K * (rows/rb + 2) + 1).  A too-small cap
+        would silently DROP row-blocks, so the default wave formula must
+        cover the wave flows."""
         from .ops.hist_pallas import build_histogram_segments
         W = sm_slot.shape[0]        # wave width (narrow on ramp waves)
         rb = self._seg_rb
         # sortable smaller-child windows are disjoint (<= n_pad rows total);
         # frozen members scan their shared parent span (<= wave cutoff each)
         wc = min(self._wave_cutoff, self._rows_len())
-        T = self._rows_len() // rb + W + W * (wc // rb + 2) + 1
+        T = (t_cap if t_cap is not None
+             else self._rows_len() // rb + W + W * (wc // rb + 2) + 1)
         first_blk = jnp.where(valid, sm_start // rb, 0)
         last_blk = jnp.where(
             valid, (sm_start + jnp.maximum(sm_cnt, 1) - 1) // rb, 0)
@@ -988,6 +996,44 @@ class WaveTPUTreeLearner(CompactTPUTreeLearner):
 
         return branch
 
+    # batch extras must fit a bounded slice so the vectorized partition's
+    # stacked (K-1, fw, S) transients stay small; bigger-span leaves can
+    # only be corrected as the top of their own event (rare: big spans
+    # stall early, at the top of the tree)
+    _VEC_CAP = 1 << 17
+
+    def _make_stall_vec_branch(self, S: int, Ke: int):
+        """Lid-only partition of Ke covering spans at once: Ke slices of
+        one bucket, ONE vmapped ``_span_decide``, Ke masked write-backs.
+        Replaces Ke sequential bucket switches (~0.2 ms each on v5e) with
+        one fused stage; disjoint lid values make the sequential
+        dynamic-update chain commute even when members share a span."""
+        fw, n = self.fw, self._rows_len()
+
+        def branch(bins_p, w_p, lid_p, starts, cnts, leaves, feats, thrs,
+                   dlefts, iscats, catbits, l0v, r0v):
+            sas = jnp.clip(starts, 0, n - S).astype(jnp.int32)
+            offs = (starts - sas).astype(jnp.int32)
+            z = jnp.int32(0)
+            bw_k = jnp.stack([lax.dynamic_slice(bins_p, (z, sas[i]),
+                                                (fw, S))
+                              for i in range(Ke)])
+            ww_k = jnp.stack([lax.dynamic_slice(w_p, (z, sas[i]), (3, S))
+                              for i in range(Ke)])
+            lid_k = jnp.stack([lax.dynamic_slice(lid_p, (sas[i],), (S,))
+                               for i in range(Ke)])
+            in_seg, go_left, lc, cb = jax.vmap(self._span_decide)(
+                bw_k, ww_k, lid_k, offs, cnts, leaves, feats, thrs,
+                dlefts, iscats, catbits)
+            for i in range(Ke):
+                cur = lax.dynamic_slice(lid_p, (sas[i],), (S,))
+                new = jnp.where(in_seg[i],
+                                jnp.where(go_left[i], l0v[i], r0v[i]), cur)
+                lid_p = lax.dynamic_update_slice(lid_p, new, (sas[i],))
+            return lid_p, lc, cb
+
+        return branch
+
     def _stall_split_batch(self, st: WaveState, tops, bvalid,
                            feature_mask) -> WaveState:
         """Split up to K frontier leaves in ONE replay correction pass.
@@ -1018,46 +1064,69 @@ class WaveTPUTreeLearner(CompactTPUTreeLearner):
         # which also lets the growth loop skip the pre-replay
         # materialization sort entirely
         spans = st.phys_i[tops]           # (K, 2)
-        acc0 = (st.lid_p, jnp.zeros(K, jnp.int32), jnp.zeros(K, jnp.int32),
-                jnp.zeros((K, 2) + h_t.shape, h_t.dtype))
-
-        def body(i, carry):
-            lid_p, lc_a, c_a, h2_a = carry
-            top = tops[i]
-            ok = bvalid[i]
-            crow_i = st.cand_i[top]
-            feat = crow_i[CI_FEAT]
-            thr = crow_i[CI_THR]
-            dleft = (crow_i[CI_FLAGS] & 1) == 1
-            is_cat = (crow_i[CI_FLAGS] & 2) == 2
-            cat_bits = st.cand_b[top]
-            s = spans[i, 0]
-            # an invalid member degrades to a zero-row no-op in the
-            # smallest bucket; all writes below are masked or dropped
-            c = jnp.where(ok, spans[i, 1], 0)
-            pidx = self._bucket_idx(jnp.maximum(c, 1))
-            lid_p, lc_bag, c_bag = lax.switch(
-                pidx, self._stall_mask_branches, bins_p, w_p, lid_p, s, c,
-                top, feat, thr, dleft, is_cat, cat_bits, l0s[i], r0s[i])
-            lc_bag, c_bag = self._sync_counts(lc_bag, c_bag)
-            # smaller-child histogram over the span with a lid mask;
-            # sibling by subtraction from the parent's pooled histogram
-            left_small = lc_bag <= (c_bag - lc_bag)
-            sm_slot = jnp.where(left_small, l0s[i], r0s[i])
-            h_small = self._reduce_hist(
-                lax.switch(pidx, self._hist_branches, bins_p, w_p, lid_p,
-                           s, c, sm_slot))
-            h_par = st.hist_pool[phs[i]]
-            h_large = h_par - h_small
-            hl = jnp.where(left_small, h_small, h_large)
-            hr = jnp.where(left_small, h_large, h_small)
-            lc_a = lc_a.at[i].set(lc_bag)
-            c_a = c_a.at[i].set(c_bag)
-            h2_a = h2_a.at[i, 0].set(hl).at[i, 1].set(hr)
-            return (lid_p, lc_a, c_a, h2_a)
-
-        lid_p, lc_a, c_a, h2_a = lax.fori_loop(0, K, body, acc0)
-        hists2 = h2_a.reshape((2 * K,) + h_t.shape)
+        # Partition stage — UNROLLED over the (static, small) K:
+        # straight-line code whose only sequential state is the lid-lane
+        # dynamic-update chain, which XLA aliases in place (a fori_loop
+        # here paid ~0.35 ms of while-loop overhead per correction event
+        # on v5e, and a first cut with per-member histograms inside the
+        # loop paid ~0.4 ms per member in switch dispatches)
+        lid_p = st.lid_p
+        cs = jnp.where(bvalid, spans[:, 1], 0)
+        # the TOP (member 0) partitions through its own bucket switch —
+        # its span is ungated; an invalid/zero-count member degrades to a
+        # zero-row no-op in the smallest bucket, writes masked or dropped
+        crow0 = st.cand_i[tops[0]]
+        lid_p, lc0, c0 = lax.switch(
+            self._bucket_idx(jnp.maximum(cs[0], 1)),
+            self._stall_mask_branches, bins_p, w_p, lid_p,
+            spans[0, 0], cs[0], tops[0], crow0[CI_FEAT], crow0[CI_THR],
+            (crow0[CI_FLAGS] & 1) == 1, (crow0[CI_FLAGS] & 2) == 2,
+            st.cand_b[tops[0]], l0s[0], r0s[0])
+        if K > 1:
+            # the EXTRAS (span-gated <= _VEC_CAP in do_stall) partition in
+            # ONE vectorized stage
+            ci_e = st.cand_i[tops[1:]]
+            vsz = self._vec_sizes_arr
+            vidx = jnp.sum(jnp.maximum(jnp.max(cs[1:]), 1)
+                           > vsz).astype(jnp.int32)
+            vidx = jnp.minimum(vidx, len(self._stall_vec_branches) - 1)
+            lid_p, lc_e, c_e = lax.switch(
+                vidx, self._stall_vec_branches, bins_p, w_p, lid_p,
+                spans[1:, 0], cs[1:], tops[1:], ci_e[:, CI_FEAT],
+                ci_e[:, CI_THR], (ci_e[:, CI_FLAGS] & 1) == 1,
+                (ci_e[:, CI_FLAGS] & 2) == 2, st.cand_b[tops[1:]],
+                l0s[1:], r0s[1:])
+            lc_s = jnp.concatenate([lc0[None], lc_e])
+            c_s = jnp.concatenate([c0[None], c_e])
+        else:
+            lc_s = lc0[None]
+            c_s = c0[None]
+        # ONE count sync (the sharded learners psum the (K,) pair once
+        # instead of per member)
+        lc_a, c_a = self._sync_counts(lc_s, c_s)
+        left_small = lc_a <= (c_a - lc_a)
+        sm_slot = jnp.where(left_small, l0s, r0s)
+        # Histogram stage — ONE segment-kernel pass over every member's
+        # smaller child (same machinery as the wave member hists), then
+        # batched sibling subtraction from the parents' pooled histograms
+        st2 = st._replace(lid_p=lid_p)
+        if self._use_pallas:
+            t_cap = K * (self._rows_len() // self._seg_rb + 2) + 1
+            h_small = self._reduce_hist(self._segment_hists(
+                st2, sm_slot, spans[:, 0], cs, bvalid, t_cap=t_cap))
+        else:
+            h_small = jnp.stack([
+                self._reduce_hist(lax.switch(
+                    self._bucket_idx(jnp.maximum(cs[i], 1)),
+                    self._hist_branches, bins_p, w_p, lid_p, spans[i, 0],
+                    cs[i], sm_slot[i]))
+                for i in range(K)])
+        h_par = st.hist_pool[phs]                     # (K, F, B, 3)
+        h_large = h_par - h_small
+        lsm = left_small[:, None, None, None]
+        hl = jnp.where(lsm, h_small, h_large)
+        hr = jnp.where(lsm, h_large, h_small)
+        hists2 = jnp.stack([hl, hr], 1).reshape((2 * K,) + h_t.shape)
         # ONE masked pool write outside the loop (the pool never rides
         # the loop carry)
         i2 = jnp.stack([jnp.where(bvalid, phs, OOBH),
@@ -1095,6 +1164,13 @@ class WaveTPUTreeLearner(CompactTPUTreeLearner):
         if self._stall_batch > 1:
             self._stall_mask_branches = [self._make_stall_mask_branch(S)
                                          for S in self._win_sizes]
+            vec_sizes = [S for S in self._win_sizes if S <= self._VEC_CAP]
+            if not vec_sizes:
+                vec_sizes = [self._win_sizes[0]]
+            self._vec_sizes_arr = jnp.asarray(vec_sizes, dtype=jnp.int32)
+            self._stall_vec_branches = [
+                self._make_stall_vec_branch(S, self._stall_batch - 1)
+                for S in vec_sizes]
         M, budget = self.M, self.budget
         OOB = jnp.int32(M + 7)
         NEG = jnp.finfo(jnp.float32).min
@@ -1224,12 +1300,14 @@ class WaveTPUTreeLearner(CompactTPUTreeLearner):
                 tops_k = osel[:Kb]
                 bv = cand_u[tops_k]
                 # EXTRAS (members beyond the top) count against the
-                # dedicated _stall_extras_cap reserve; the top itself is
+                # dedicated _stall_extras_cap reserve and must fit the
+                # vectorized partition's slice cap; the top itself is
                 # always safe — each top maps to a distinct pop, which the
                 # budget-sized share of the reserve covers
                 head = (extras + jnp.arange(-1, Kb - 1, dtype=jnp.int32)) \
                     < jnp.int32(self._extras_cap)
-                bv = bv & (head | (jnp.arange(Kb) == 0))
+                fits = s.phys_i[tops_k, 1] <= jnp.int32(self._VEC_CAP)
+                bv = bv & ((head & fits) | (jnp.arange(Kb) == 0))
                 s2 = self._stall_split_batch(s, tops_k, bv, feature_mask)
                 nsp = jnp.sum(bv, dtype=jnp.int32).astype(jnp.int32)
                 return s2, nsp, nsp - bv[0].astype(jnp.int32)
