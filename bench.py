@@ -21,6 +21,11 @@ dual socket, so parity with 22.0 at 1M ≈ 2× the single-socket bar.)
 
 Usage: ``python bench.py``          — both scales, one JSON line.
        ``python bench.py ROWS [IT]`` — one scale (profiling convenience).
+       ``--telemetry-out PATH``      — train with ``telemetry=True`` and
+       write the per-scale JSON telemetry reports (phase timings, wave /
+       stall counters, collective accounting — observability/schema.json)
+       next to the headline metric, so BENCH_r*.json rounds carry phase
+       breakdowns.
 """
 
 import gc
@@ -28,11 +33,14 @@ import json
 import sys
 import time
 
+
 import numpy as np
 
 
-def run_scale(rows: int, iters: int, warmup: int = 2) -> float:
-    """Train steady-state iterations at one scale; returns iters/sec."""
+def run_scale(rows: int, iters: int, warmup: int = 2,
+              telemetry: bool = False):
+    """Train steady-state iterations at one scale; returns
+    (iters/sec, telemetry report or None)."""
     import lightgbm_tpu as lgb
 
     rng = np.random.RandomState(7)
@@ -44,7 +52,7 @@ def run_scale(rows: int, iters: int, warmup: int = 2) -> float:
 
     params = {"objective": "binary", "num_leaves": 255, "max_bin": 255,
               "learning_rate": 0.1, "min_data_in_leaf": 20,
-              "verbosity": -1, "metric": "none"}
+              "verbosity": -1, "metric": "none", "telemetry": telemetry}
     ds = lgb.Dataset(X, label=y, params=params)
     bst = lgb.Booster(params, ds)
 
@@ -61,44 +69,81 @@ def run_scale(rows: int, iters: int, warmup: int = 2) -> float:
         bst.update()
     sync()
     dt = time.time() - t0
+    report = bst.gbdt.get_telemetry() if telemetry else None
     del bst, ds, X, y  # release device buffers before the next scale
     gc.collect()
-    return iters / dt
+    return iters / dt, report
 
 
 def ref_ips(rows: int) -> float:
     return (500.0 / 238.5) * (10.5e6 / rows)  # reference CPU, row-scaled
 
 
+def _pop_telemetry_arg(argv):
+    """Extract ``--telemetry-out PATH`` / ``--telemetry-out=PATH``."""
+    out = None
+    rest = []
+    i = 0
+    while i < len(argv):
+        a = argv[i]
+        if a.startswith("--telemetry-out"):
+            if "=" in a:
+                out = a.split("=", 1)[1]
+            elif i + 1 < len(argv):
+                i += 1
+                out = argv[i]
+        else:
+            rest.append(a)
+        i += 1
+    return out, rest
+
+
 def main():
-    if len(sys.argv) > 1:  # single-scale profiling mode
-        rows = int(sys.argv[1])
-        iters = int(sys.argv[2]) if len(sys.argv) > 2 else 10
-        ips = run_scale(rows, iters)
-        print(json.dumps({
+    telemetry_out, argv = _pop_telemetry_arg(sys.argv[1:])
+    telem = telemetry_out is not None
+    reports = {}
+    if argv:  # single-scale profiling mode
+        rows = int(argv[0])
+        iters = int(argv[1]) if len(argv) > 1 else 10
+        ips, rep = run_scale(rows, iters, telemetry=telem)
+        if rep is not None:
+            reports[str(rows)] = rep
+        line = {
             "metric": f"boosting iters/sec (synthetic Higgs-like {rows}x28, "
                       "255 leaves, 255 bins)",
             "value": round(ips, 4),
             "unit": "iters/sec",
             "vs_baseline": round(ips / ref_ips(rows), 4),
-        }))
-        return
-
-    # the reference's Higgs number times 500 iterations end-to-end; the
-    # axon tunnel's flat ~105 ms device->host sync lands ONCE per timed
-    # loop, so more steady-state iterations = closer to the reference's
-    # methodology (at 10 iters the artifact alone was ~10.5 ms/iter, ~8%)
-    ips_1m = run_scale(1_000_000, 30)
-    ips_full = run_scale(10_500_000, 6)
-    print(json.dumps({
-        "metric": "boosting iters/sec (synthetic Higgs-like 1Mx28, "
-                  "255 leaves, 255 bins; _10p5m = reference row count)",
-        "value": round(ips_1m, 4),
-        "unit": "iters/sec",
-        "vs_baseline": round(ips_1m / ref_ips(1_000_000), 4),
-        "value_10p5m": round(ips_full, 4),
-        "vs_baseline_10p5m": round(ips_full / ref_ips(10_500_000), 4),
-    }))
+        }
+    else:
+        # the reference's Higgs number times 500 iterations end-to-end; the
+        # axon tunnel's flat ~105 ms device->host sync lands ONCE per timed
+        # loop, so more steady-state iterations = closer to the reference's
+        # methodology (at 10 iters the artifact alone was ~10.5 ms/iter, ~8%)
+        ips_1m, rep_1m = run_scale(1_000_000, 30, telemetry=telem)
+        ips_full, rep_full = run_scale(10_500_000, 6, telemetry=telem)
+        if rep_1m is not None:
+            reports["1000000"] = rep_1m
+            reports["10500000"] = rep_full
+        line = {
+            "metric": "boosting iters/sec (synthetic Higgs-like 1Mx28, "
+                      "255 leaves, 255 bins; _10p5m = reference row count)",
+            "value": round(ips_1m, 4),
+            "unit": "iters/sec",
+            "vs_baseline": round(ips_1m / ref_ips(1_000_000), 4),
+            "value_10p5m": round(ips_full, 4),
+            "vs_baseline_10p5m": round(ips_full / ref_ips(10_500_000), 4),
+        }
+    if telem:
+        from lightgbm_tpu.observability import validate_report
+        for rep in reports.values():
+            errs = validate_report(rep)
+            assert not errs, errs
+        with open(telemetry_out, "w") as fh:
+            json.dump(reports, fh, indent=2, sort_keys=True)
+            fh.write("\n")
+        line["telemetry_out"] = telemetry_out
+    print(json.dumps(line))
 
 
 if __name__ == "__main__":

@@ -14,7 +14,7 @@ from .config import Config
 from .dataset import Dataset
 from .engine import Booster, CVBooster, cv, train
 from .callback import (early_stopping, print_evaluation, record_evaluation,
-                       reset_parameter)
+                       record_telemetry, reset_parameter)
 
 try:  # sklearn wrappers are optional on minimal installs
     from .sklearn import (LGBMClassifier, LGBMModel, LGBMRanker,
@@ -36,4 +36,4 @@ __version__ = "2.2.4.tpu0"
 __all__ = ["Dataset", "Booster", "CVBooster", "Config",
            "train", "cv",
            "early_stopping", "print_evaluation", "record_evaluation",
-           "reset_parameter"] + _SKLEARN + _PLOT
+           "record_telemetry", "reset_parameter"] + _SKLEARN + _PLOT

@@ -255,6 +255,8 @@ class GBDT:
                  objective: Optional[ObjectiveFunction] = None):
         self.cfg = cfg
         self.iter_ = 0
+        from ..observability import Telemetry
+        self.telemetry = Telemetry(bool(getattr(cfg, "telemetry", False)))
         self._pending: List[tuple] = []
         self._stopped = False
         self._model_version = 0          # bumped on in-place tree mutation
@@ -310,6 +312,8 @@ class GBDT:
         if not pend:
             return
         self._pending = []
+        tel = self.telemetry
+        _flush_t0 = time.perf_counter() if tel.enabled else 0.0
         # the record arrays were copy_to_host_async'd at dispatch time, so
         # these np.asarray calls find host-resident data (~0.2 ms each);
         # only records of still-executing queued trees block, on execution
@@ -364,6 +368,14 @@ class GBDT:
                 warnings.warn("Stopped training because there are no more "
                               "leaves that meet the split requirements")
                 break
+        if tel.enabled:
+            tel.add_phase_time("pipeline_flush",
+                               time.perf_counter() - _flush_t0)
+            tel.inc("pipeline_flushes")
+            tel.inc("trees_assembled", len(pend))
+            # the per-tree device counter vectors rode the same async
+            # copies as the records — decode them now, off the hot path
+            tel.flush_device()
 
     # -- GBDT::Init (`gbdt.cpp:45-137`) -------------------------------------
 
@@ -444,14 +456,16 @@ class GBDT:
         cfg = self.cfg
         if cfg.bagging_freq > 0 and cfg.bagging_fraction < 1.0 \
                 and iter_ % cfg.bagging_freq == 0:
-            n = self.num_data
-            bag_cnt = int(cfg.bagging_fraction * n)
-            idx = self._bag_rng.choice(n, bag_cnt, replace=False)
-            mask = np.zeros(self.train_data.num_data_padded, dtype=np.float32)
-            mask[idx] = 1.0
-            self._bag_mask = self._place_rows(mask)
-            self._np_bag_mask = mask
-            self._bag_cnt = bag_cnt
+            with self.telemetry.phase("bagging"):
+                n = self.num_data
+                bag_cnt = int(cfg.bagging_fraction * n)
+                idx = self._bag_rng.choice(n, bag_cnt, replace=False)
+                mask = np.zeros(self.train_data.num_data_padded,
+                                dtype=np.float32)
+                mask[idx] = 1.0
+                self._bag_mask = self._place_rows(mask)
+                self._np_bag_mask = mask
+                self._bag_cnt = bag_cnt
 
     def _np_bag(self) -> np.ndarray:
         """Host copy of the bagging mask, materialized lazily (device-side
@@ -496,7 +510,8 @@ class GBDT:
                 return jnp.stack(gs), jnp.stack(hs)
 
             self._jit_grad_fn = jax.jit(grad_all)
-        return self._jit_grad_fn(self.train_score.score)
+        with self.telemetry.phase("gradients"):
+            return self._jit_grad_fn(self.train_score.score)
 
     # -- one boosting iteration (`gbdt.cpp:333-413`) -------------------------
 
@@ -514,6 +529,12 @@ class GBDT:
     def train_one_iter(self, gradients: Optional[np.ndarray] = None,
                        hessians: Optional[np.ndarray] = None) -> bool:
         """Returns True when training cannot continue (no splittable leaves)."""
+        if not self.telemetry.enabled:
+            return self._train_one_iter_inner(gradients, hessians)
+        with self.telemetry.phase("iteration"):
+            return self._train_one_iter_inner(gradients, hessians)
+
+    def _train_one_iter_inner(self, gradients=None, hessians=None) -> bool:
         if self._stopped:
             return True
         init_scores = [0.0] * self.num_tree_per_iteration
@@ -555,29 +576,37 @@ class GBDT:
 
             def step(score, bins_p, bag, fmask, lr):
                 g, h = obj.get_gradients(score[0], 0)
-                rec_f, rec_i, rec_cat, leaf_id, leaf_out = tree_fn(
-                    bins_p, g, h, bag, fmask)
+                out = tree_fn(bins_p, g, h, bag, fmask)
+                rec_f, rec_i, rec_cat, leaf_id, leaf_out = out[:5]
                 score = score.at[0].add(lr * jnp.take(leaf_out, leaf_id))
-                return score, rec_f, rec_i, rec_cat
+                # out[5:] is the telemetry counter lane (present only when
+                # cfg.telemetry — the program is unchanged otherwise)
+                return (score, rec_f, rec_i, rec_cat) + tuple(out[5:])
 
             self._jit_fused = jax.jit(step, donate_argnums=(0,))
         return self._jit_fused
 
     def _train_trees_fused(self, init_scores) -> bool:
+        tel = self.telemetry
         if self.shrinkage_rate != self._lr_dev_val:
             self._lr_dev = jnp.float32(self.shrinkage_rate)
             self._lr_dev_val = self.shrinkage_rate
         fmask = self._feature_sample()
-        score, rec_f, rec_i, rec_cat = self._fused_iter_fn()(
-            self.train_score.score, self.learner.bins_packed(),
-            self._bag_mask, fmask, self._lr_dev)
+        with tel.phase("tree_dispatch"):
+            out = self._fused_iter_fn()(
+                self.train_score.score, self.learner.bins_packed(),
+                self._bag_mask, fmask, self._lr_dev)
+        score, rec_f, rec_i, rec_cat = out[:4]
+        telem = out[4] if len(out) > 4 else None
         self.train_score.score = score
         # start the device->host record copies NOW: they stream behind the
         # still-queued tree programs, so the 16-iteration flush finds them
         # host-resident (a cold fetch costs ~105 ms flat on the axon
         # tunnel; pre-copied ~0.2 ms — profiling/probe_async_fetch.py)
-        for a in (rec_f, rec_i, rec_cat):
+        for a in (rec_f, rec_i, rec_cat) + (() if telem is None
+                                            else (telem,)):
             a.copy_to_host_async()
+        tel.device_telem(telem)
         self._pending.append((len(self._models), rec_f, rec_i, rec_cat,
                               init_scores[0]))
         self._models.append(None)
@@ -598,18 +627,27 @@ class GBDT:
     def _train_trees_pipelined(self, grad, hess, init_scores) -> bool:
         """Sync-free iteration: tree build + device score update dispatched
         asynchronously; host trees materialize lazily in ``_flush_pending``."""
+        tel = self.telemetry
         if self.shrinkage_rate != self._lr_dev_val:
             self._lr_dev = jnp.float32(self.shrinkage_rate)
             self._lr_dev_val = self.shrinkage_rate
         for k in range(self.num_tree_per_iteration):
             fmask = self._feature_sample()
-            rec_f, rec_i, rec_cat, leaf_id, leaf_out = \
-                self.learner.train_async(grad[k], hess[k], self._bag_mask,
-                                         fmask)
-            self.train_score.score = _score_add_leaf(
-                self.train_score.score, leaf_out, leaf_id, self._lr_dev, k)
-            for a in (rec_f, rec_i, rec_cat):  # see _train_trees_fused
-                a.copy_to_host_async()
+            with tel.phase("tree_dispatch"):
+                rec_f, rec_i, rec_cat, leaf_id, leaf_out = \
+                    self.learner.train_async(grad[k], hess[k],
+                                             self._bag_mask, fmask)
+            with tel.phase("score_update"):
+                self.train_score.score = _score_add_leaf(
+                    self.train_score.score, leaf_out, leaf_id,
+                    self._lr_dev, k)
+            telem = self.learner.take_telemetry() \
+                if tel.enabled and hasattr(self.learner, "take_telemetry") \
+                else None
+            for a in (rec_f, rec_i, rec_cat) + (() if telem is None
+                                                else (telem,)):
+                a.copy_to_host_async()  # see _train_trees_fused
+            tel.device_telem(telem)
             self._pending.append((len(self._models), rec_f, rec_i, rec_cat,
                                   init_scores[k]))
             self._models.append(None)
@@ -625,14 +663,21 @@ class GBDT:
         (`gbdt.cpp:348-413`)."""
         if self._can_pipeline():
             return self._train_trees_pipelined(grad, hess, init_scores)
+        tel = self.telemetry
         should_continue = False
         for k in range(self.num_tree_per_iteration):
             new_tree = Tree(2)
             leaf_id = None
             if self.class_need_train[k] and self.train_data.num_used_features > 0:
                 fmask = self._feature_sample()
-                new_tree, leaf_id = self.learner.train(
-                    grad[k], hess[k], self._bag_mask, fmask)
+                with tel.phase("tree_train"):
+                    new_tree, leaf_id = self.learner.train(
+                        grad[k], hess[k], self._bag_mask, fmask)
+                if tel.enabled and hasattr(self.learner, "take_telemetry"):
+                    telem = self.learner.take_telemetry()
+                    if telem is not None:
+                        telem.copy_to_host_async()
+                        tel.device_telem(telem)
             if new_tree.num_leaves > 1:
                 should_continue = True
                 if self.objective is not None:
@@ -769,6 +814,33 @@ class GBDT:
 
     def _metric_score(self, updater: ScoreUpdater) -> np.ndarray:
         return updater.np_score()
+
+    # -- telemetry (observability/) ------------------------------------------
+
+    def get_telemetry(self, light: bool = False) -> Dict[str, Any]:
+        """The JSON telemetry report (observability/schema.json).
+
+        ``light=True`` skips flushing queued pipelined trees — safe to
+        call every iteration (``callback.record_telemetry``) because it
+        never forces a device sync; the default flushes so the report
+        covers every dispatched tree."""
+        tel = self.telemetry
+        if not light:
+            self._flush_pending()
+            tel.flush_device()
+        ledger = getattr(self.learner, "_ledger", None)
+        gauges = {}
+        if self.learner is not None and \
+                hasattr(self.learner, "memory_gauges"):
+            gauges["wave_working_set"] = self.learner.memory_gauges()
+        if self.learner is not None:
+            gauges["learner"] = type(self.learner).__name__
+            # batched-extras reserve: counters["stall_extras"] is usage
+            # against this per-tree cap (learner_wave._stall_extras_cap)
+            if hasattr(self.learner, "_extras_cap"):
+                gauges["stall_extras_cap"] = int(self.learner._extras_cap)
+                gauges["stall_vec_cap"] = int(self.learner._vec_cap)
+        return tel.report(ledger=ledger, extra_gauges=gauges, light=light)
 
     # -- prediction ----------------------------------------------------------
 

@@ -67,6 +67,27 @@ def record_evaluation(eval_result: Dict) -> Callable:
     return _callback
 
 
+def record_telemetry(result: Dict) -> Callable:
+    """Fill ``result`` with the booster's telemetry report each iteration
+    (requires ``telemetry=True`` in params; see README "Telemetry &
+    profiling").  Uses the LIGHT report — already-decoded phase timers and
+    counters only — so the callback never forces a device sync; call
+    ``Booster.get_telemetry()`` after training for the complete report."""
+    if not isinstance(result, dict):
+        raise TypeError("record_telemetry expects a dictionary to fill")
+    result.clear()
+
+    def _callback(env: CallbackEnv) -> None:
+        gbdt = getattr(env.model, "gbdt", None)
+        if gbdt is None or not getattr(gbdt, "telemetry", None) \
+                or not gbdt.telemetry.enabled:
+            return
+        result.clear()
+        result.update(gbdt.get_telemetry(light=True))
+    _callback.order = 40
+    return _callback
+
+
 def reset_parameter(**kwargs) -> Callable:
     def _callback(env: CallbackEnv) -> None:
         new_params = {}

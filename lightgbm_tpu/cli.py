@@ -30,13 +30,29 @@ from .config import Config, parse_config_file, resolve_aliases
 
 def _load_params(argv: List[str]) -> Dict[str, str]:
     """`Application::LoadParameters` (`application.cpp:48-81`): command line
-    first, then the config file (command line wins)."""
+    first, then the config file (command line wins).  GNU-style flags are
+    accepted alongside ``key=value`` tokens — ``--telemetry-out report.json``
+    and ``--telemetry-out=report.json`` both resolve to
+    ``telemetry_out=report.json`` (a bare flag with no value means true)."""
     cmdline: Dict[str, str] = {}
-    for tok in argv:
-        if "=" not in tok:
-            continue
-        k, v = tok.split("=", 1)
-        cmdline[k.strip()] = v.strip().strip('"').strip("'")
+    i = 0
+    while i < len(argv):
+        tok = argv[i]
+        if tok.startswith("--"):
+            key = tok[2:].replace("-", "_")
+            if "=" in key:
+                key, v = key.split("=", 1)
+            elif i + 1 < len(argv) and "=" not in argv[i + 1] \
+                    and not argv[i + 1].startswith("--"):
+                i += 1
+                v = argv[i]
+            else:
+                v = "true"
+            cmdline[key.strip()] = v.strip().strip('"').strip("'")
+        elif "=" in tok:
+            k, v = tok.split("=", 1)
+            cmdline[k.strip()] = v.strip().strip('"').strip("'")
+        i += 1
     cmdline = resolve_aliases(cmdline)
     params: Dict[str, str] = {}
     if "config" in cmdline:
@@ -54,6 +70,10 @@ def run_train(params: Dict[str, str], cfg: Config) -> None:
     from . import engine
     from .dataset import Dataset
 
+    # --telemetry-out implies telemetry: asking for the report IS opting in
+    if cfg.telemetry_out and not cfg.telemetry:
+        cfg.telemetry = True
+        params = dict(params, telemetry="true")
     t0 = time.time()
     train_set = Dataset(cfg.data, params=dict(params))
     valid_sets = []
@@ -74,6 +94,9 @@ def run_train(params: Dict[str, str], cfg: Config) -> None:
     booster.save_model(cfg.output_model)
     if cfg.convert_model_language == "cpp":
         _save_if_else(booster, cfg.convert_model)
+    if cfg.telemetry and cfg.telemetry_out:
+        # engine.train wrote the report already; log where it landed
+        _log(f"Telemetry report written to {cfg.telemetry_out}")
     _log(f"Finished training in {time.time() - t0:.6f} seconds")
 
 

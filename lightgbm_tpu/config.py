@@ -365,6 +365,24 @@ class Config:
     # both levels.  Halves the number of full-array sorts — the wave
     # learner's largest per-wave cost (~6 ms each on v5e at 1M rows)
     tpu_wave_defer_sorts: bool = True
+    # --- observability ---
+    # structured training telemetry (observability/): host phase timers,
+    # per-tree device counters (waves, sorts, stall/extras, pops) decoded
+    # from the async record flush, and collective accounting for the
+    # sharded learners.  Off by default — the disabled path traces the
+    # exact same jaxpr as a build without telemetry
+    telemetry: bool = False
+    # write the JSON telemetry report (observability/schema.json) to this
+    # path when training finishes (engine.train / the CLI --telemetry-out)
+    telemetry_out: str = ""
+    # when set, wrap training in jax.profiler.start_trace/stop_trace with
+    # this output directory — real per-op device timings over the tunnel
+    # (profiling/PROFILE.md); independent of the counter layer above
+    profile_trace_dir: str = ""
+    # dev/test knob: override the batched replay correction's vectorized
+    # span cap (_VEC_CAP, default 2^17 rows).  Tests shrink it so the
+    # replicated span gate is exercised at CI problem sizes
+    tpu_wave_vec_cap: int = -1
     # replay stall correction batch: when the exact greedy replay reaches
     # a leaf the speculative growth never split, split up to this many of
     # the highest-priority unsplit frontier leaves in ONE correction pass

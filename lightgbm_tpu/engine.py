@@ -39,13 +39,19 @@ class Booster:
         self._train_set = train_set
         self.gbdt: Optional[GBDT] = None
         if train_set is not None:
+            import time as _time
+            _t0 = _time.perf_counter()
             train_set.construct()
+            _bin_s = _time.perf_counter() - _t0
             objective = create_objective(self.cfg)
             self.gbdt = create_boosting(self.cfg)
             train_metrics = []
             if self.cfg.is_provide_training_metric:
                 train_metrics = self._make_metrics(train_set)
             self.gbdt.init(train_set, objective, train_metrics)
+            # binning happened before the GBDT (and its Telemetry) existed
+            # — credit it to the report's "binning" phase after the fact
+            self.gbdt.telemetry.add_phase_time("binning", _bin_s)
         elif model_file is not None:
             with open(model_file) as fh:
                 self._load_from_string(fh.read())
@@ -221,6 +227,11 @@ class Booster:
                            iteration: int = -1) -> np.ndarray:
         return self.gbdt.feature_importance(importance_type, iteration)
 
+    def get_telemetry(self, light: bool = False) -> Dict:
+        """Training telemetry report (``telemetry=True`` in params; see
+        README "Telemetry & profiling" and observability/schema.json)."""
+        return self.gbdt.get_telemetry(light=light)
+
     def feature_name(self) -> List[str]:
         return list(self.gbdt.feature_names)
 
@@ -306,6 +317,17 @@ def train(params: Dict, train_set: Dataset, num_boost_round: int = 100,
 
     init_iter = booster.current_iteration
     evaluation_result_list: List[Tuple] = []
+    # opt-in jax.profiler device trace around the training loop — real
+    # per-op timings (works over the remote tunnel, profiling/PROFILE.md)
+    _tracing = False
+    if cfg_probe.profile_trace_dir:
+        try:
+            import jax as _jax
+            _jax.profiler.start_trace(cfg_probe.profile_trace_dir)
+            _tracing = True
+        except Exception as e:
+            warnings.warn(f"profile_trace_dir set but the profiler trace "
+                          f"could not start: {e}")
     for i in range(init_iter, init_iter + num_boost_round):
         env = callback_mod.CallbackEnv(
             model=booster, params=params, iteration=i,
@@ -332,9 +354,18 @@ def train(params: Dict, train_set: Dataset, num_boost_round: int = 100,
             break
         if finished:
             break
+    if _tracing:
+        try:
+            import jax as _jax
+            _jax.profiler.stop_trace()
+        except Exception as e:
+            warnings.warn(f"profiler trace did not stop cleanly: {e}")
     if booster.best_iteration <= 0:
         for name, mname, val, _ in (evaluation_result_list or []):
             booster.best_score.setdefault(name, {})[mname] = val
+    if cfg_probe.telemetry and cfg_probe.telemetry_out:
+        from .observability import write_report
+        write_report(booster.get_telemetry(), cfg_probe.telemetry_out)
     return booster
 
 

@@ -192,9 +192,33 @@ class TPUTreeLearner:
                     pen[k] = float(fc[int(j)])
         self.has_penalty = bool((pen != 1.0).any())
         self.f_penalty = jnp.asarray(pen) if self.has_penalty else None
+        # observability: telemetry is a STATIC trace-time flag — when off,
+        # every learner traces the exact jaxpr it traced before the
+        # telemetry layer existed (the device counter lane stays None)
+        from .observability import CollectiveLedger
+        self._telemetry = bool(getattr(cfg, "telemetry", False))
+        self._ledger = CollectiveLedger(enabled=self._telemetry)
+        self._coll_ctx = ("tree", "tree")   # (phase, cadence) for _rec_coll
+        self._last_telem = None
         self._jit_init = jax.jit(self._init_root)
         self._jit_step = jax.jit(self._split_step, donate_argnums=(0,))
         self._jit_tree = jax.jit(self._train_tree_fused)
+
+    # -- observability seams --------------------------------------------------
+
+    def _rec_coll(self, op: str, payload) -> None:
+        """Trace-time collective accounting hook: the sharded seams call
+        this next to each lax collective they issue (no-op when telemetry
+        is off; never emits device ops)."""
+        if self._ledger.enabled:
+            phase, cadence = self._coll_ctx
+            self._ledger.record(op, payload, phase, cadence)
+
+    def take_telemetry(self):
+        """Pop the last tree's device counter vector (None for learners
+        without a device counter lane)."""
+        t, self._last_telem = self._last_telem, None
+        return t
 
     # -- device functions ----------------------------------------------------
 

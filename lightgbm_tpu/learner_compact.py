@@ -181,6 +181,12 @@ class CompactTPUTreeLearner(TPUTreeLearner):
         """Histogram exchange seam; the sharded learner reduce-scatters."""
         return local_hist
 
+    def _reduce_hist_batch(self, local_hists):
+        """Batched (K, F, B, 3) histogram exchange seam — ONE collective
+        for K stacked member histograms (the sharded learner
+        psum_scatters over the feature axis); identity when local."""
+        return local_hists
+
     def _child_best_rows(self, hist_left, hist_right, crow_f, feature_mask,
                          depth_ok, constraints):
         """Children's best-split rows; the sharded learner scans feature
@@ -441,6 +447,7 @@ class CompactTPUTreeLearner(TPUTreeLearner):
         partition, smaller-child histogram, children bookkeeping, record
         emission — is shared."""
         cfg = self.cfg
+        self._coll_ctx = ("split_step", "split")
         if forced is None:
             best_leaf = jnp.argmax(state.cand_f[:, CF_GAIN]) \
                 .astype(jnp.int32)
@@ -655,6 +662,7 @@ class CompactTPUTreeLearner(TPUTreeLearner):
     def _train_tree_compact(self, bins_p, grad, hess, bag, feature_mask):
         # bins arrive as an ARGUMENT, not a closure constant — embedded
         # constants ship with every (remote) compile request
+        self._ledger.begin_trace()
         self._hist_branches = [self._make_hist_branch(S)
                                for S in self._win_sizes]
         self._partition_branches = [

@@ -57,6 +57,12 @@ from .learner_compact import (CF_GAIN, CF_LCNT, CF_LOUT, CF_LSG, CF_LSH,
                               CI_FLAGS, CI_THR, LF_CNT, LF_DEPTH, LF_MAX_C,
                               LF_MIN_C, LF_OUT, LF_SUM_G, LF_SUM_H, NUM_CF,
                               NUM_CI, NUM_LF, CompactTPUTreeLearner)
+from .observability.telemetry import (TEL_FROZEN_MEMBERS, TEL_GROW_SPLITS,
+                                      TEL_NSLOTS, TEL_POPS,
+                                      TEL_STALL_EXTRAS, TEL_STALL_SORT_MODE,
+                                      TEL_STALL_SPLITS, TEL_TOTAL_SPLITS,
+                                      TEL_WAVE_MEMBERS, TEL_WAVE_SORTS,
+                                      TEL_WAVES)
 from .ops.lookup import lookup_int
 
 _HIGH = lax.Precision.HIGHEST
@@ -125,6 +131,9 @@ class WaveState(NamedTuple):
     num_nodes: jax.Array  # () int32
     num_splits: jax.Array  # () int32
     pending: jax.Array    # () bool — keys assigned but not yet sorted
+    # (TEL_NSLOTS,) int32 device counter lane, or None when telemetry is
+    # off — None is an empty pytree, so the disabled program is unchanged
+    telem: Optional[jax.Array] = None
 
 
 class WaveTPUTreeLearner(CompactTPUTreeLearner):
@@ -199,6 +208,10 @@ class WaveTPUTreeLearner(CompactTPUTreeLearner):
         self._stall_batch = max(
             1, min(int(getattr(cfg, "tpu_wave_stall_batch", 4)), 16))
         self._extras_cap = _stall_extras_cap(self.budget)
+        # vectorized-partition span cap (tests shrink it via config so the
+        # replicated gate is exercised at CI sizes)
+        vc = int(getattr(cfg, "tpu_wave_vec_cap", -1))
+        self._vec_cap = self._VEC_CAP if vc <= 0 else vc
         corr = _correction_reserve(cfg, self.budget)
         self.M = 1 + 2 * (self.grow_budget + corr)
         self.H = self.grow_budget + corr + 2
@@ -250,6 +263,7 @@ class WaveTPUTreeLearner(CompactTPUTreeLearner):
                         ) -> WaveState:
         n, L, M, H = self._rows_len(), self.num_leaves, self.M, self.H
         acc = self._acc
+        self._coll_ctx = ("root", "tree")
         w = jnp.stack([grad * bag, hess * bag, bag], axis=0)
         lid0 = jnp.zeros(n, jnp.int32)
         root_hist = self._reduce_hist(
@@ -292,7 +306,9 @@ class WaveTPUTreeLearner(CompactTPUTreeLearner):
                          .at[0].set(root_hist),
             num_nodes=jnp.asarray(1, jnp.int32),
             num_splits=jnp.asarray(0, jnp.int32),
-            pending=jnp.asarray(False))
+            pending=jnp.asarray(False),
+            telem=(jnp.zeros(TEL_NSLOTS, jnp.int32)
+                   if self._telemetry else None))
 
     # -- one growth wave ------------------------------------------------------
 
@@ -400,6 +416,7 @@ class WaveTPUTreeLearner(CompactTPUTreeLearner):
         W = width or self.W
         M, n = self.M, self._rows_len()
         fw = self.fw
+        self._coll_ctx = ("grow_wave", "wave")
         # ---- select the wave: top-W positive-gain frontier leaves
         g = self._pool_gains(st)
         gv, wi = lax.top_k(g, W)
@@ -647,6 +664,16 @@ class WaveTPUTreeLearner(CompactTPUTreeLearner):
         st = self._children_bookkeeping(
             st, wi, valid, lslot, rslot, lc_bag, c_bag, li, ri2, ph, rh,
             hists2, feature_mask, phys_l, phys_r)
+        if st.telem is not None:
+            st = st._replace(telem=st.telem
+                             .at[TEL_WAVES].add(1)
+                             .at[TEL_WAVE_SORTS].add(
+                                 sorted_now.astype(jnp.int32))
+                             .at[TEL_WAVE_MEMBERS].add(
+                                 jnp.sum(valid, dtype=jnp.int32))
+                             .at[TEL_FROZEN_MEMBERS].add(
+                                 jnp.sum(valid & ~sortable,
+                                         dtype=jnp.int32)))
         # a sort materializes EVERY node (stale covering spans from the
         # previous deferring wave included), not just this wave's children
         return st._replace(phys_i=jnp.where(sorted_now, st.node_i,
@@ -924,9 +951,18 @@ class WaveTPUTreeLearner(CompactTPUTreeLearner):
 
         return branch
 
+    def _replicated_spans(self, spans):
+        """Replicated view of covering-span widths.  ``phys_i`` holds
+        LOCAL window geometry in the row-sharded learners, so any gate
+        derived from it must see the cross-device maximum or the replay's
+        replicated bookkeeping diverges (round-5 advisor, high); identity
+        here — the sharded wave learner overrides with ``lax.pmax``."""
+        return spans
+
     def _stall_split(self, st: WaveState, top, feature_mask) -> WaveState:
         """Split one frontier leaf outside the wave flow (the
         ``tpu_wave_stall_batch=1`` replay path)."""
+        self._coll_ctx = ("stall_correction", "stall_event")
         crow_i = st.cand_i[top]
         feat = crow_i[CI_FEAT]
         thr = crow_i[CI_THR]
@@ -1050,6 +1086,7 @@ class WaveTPUTreeLearner(CompactTPUTreeLearner):
         scans run ONCE, batched over all members."""
         K = tops.shape[0]
         OOBH = jnp.int32(self.H + 7)
+        self._coll_ctx = ("stall_correction", "stall_event")
         bv_i = bvalid.astype(jnp.int32)
         pos = jnp.cumsum(bv_i) - bv_i
         l0s = (st.num_nodes + 2 * pos).astype(jnp.int32)
@@ -1112,15 +1149,19 @@ class WaveTPUTreeLearner(CompactTPUTreeLearner):
         st2 = st._replace(lid_p=lid_p)
         if self._use_pallas:
             t_cap = K * (self._rows_len() // self._seg_rb + 2) + 1
-            h_small = self._reduce_hist(self._segment_hists(
+            h_small = self._reduce_hist_batch(self._segment_hists(
                 st2, sm_slot, spans[:, 0], cs, bvalid, t_cap=t_cap))
         else:
-            h_small = jnp.stack([
-                self._reduce_hist(lax.switch(
+            # stack the K member histograms and reduce ONCE — the sharded
+            # seam exchanges one (K, F, B, 3) collective per correction
+            # event, matching _wave_member_hists' single psum_scatter per
+            # wave (a per-member loop issued K collectives per event)
+            h_small = self._reduce_hist_batch(jnp.stack([
+                lax.switch(
                     self._bucket_idx(jnp.maximum(cs[i], 1)),
                     self._hist_branches, bins_p, w_p, lid_p, spans[i, 0],
-                    cs[i], sm_slot[i]))
-                for i in range(K)])
+                    cs[i], sm_slot[i])
+                for i in range(K)]))
         h_par = st.hist_pool[phs]                     # (K, F, B, 3)
         h_large = h_par - h_small
         lsm = left_small[:, None, None, None]
@@ -1164,7 +1205,7 @@ class WaveTPUTreeLearner(CompactTPUTreeLearner):
         if self._stall_batch > 1:
             self._stall_mask_branches = [self._make_stall_mask_branch(S)
                                          for S in self._win_sizes]
-            vec_sizes = [S for S in self._win_sizes if S <= self._VEC_CAP]
+            vec_sizes = [S for S in self._win_sizes if S <= self._vec_cap]
             if not vec_sizes:
                 vec_sizes = [self._win_sizes[0]]
             self._vec_sizes_arr = jnp.asarray(vec_sizes, dtype=jnp.int32)
@@ -1274,8 +1315,14 @@ class WaveTPUTreeLearner(CompactTPUTreeLearner):
             Kb = self._stall_batch
             if Kb == 1:
                 def do_stall1(s):
-                    return self._stall_split(s, top, feature_mask), \
-                        jnp.int32(1)
+                    sort_c = (s.node_i[top, 1]
+                              > jnp.int32(self._stall_cutoff))
+                    s2 = self._stall_split(s, top, feature_mask)
+                    if s2.telem is not None:
+                        s2 = s2._replace(
+                            telem=s2.telem.at[TEL_STALL_SORT_MODE].add(
+                                sort_c.astype(jnp.int32)))
+                    return s2, jnp.int32(1)
 
                 st, nsp = lax.cond(flag == 1, do_stall1,
                                    lambda s: (s, jnp.int32(0)), st)
@@ -1306,7 +1353,13 @@ class WaveTPUTreeLearner(CompactTPUTreeLearner):
                 # budget-sized share of the reserve covers
                 head = (extras + jnp.arange(-1, Kb - 1, dtype=jnp.int32)) \
                     < jnp.int32(self._extras_cap)
-                fits = s.phys_i[tops_k, 1] <= jnp.int32(self._VEC_CAP)
+                # the gate must be REPLICATED: phys_i spans are local
+                # window geometry in the row-sharded learners, and a leaf
+                # whose local span straddles the cap on only some shards
+                # would otherwise diverge bv (and with it num_nodes /
+                # split_m / the extras counter) across devices
+                fits = self._replicated_spans(s.phys_i[tops_k, 1]) \
+                    <= jnp.int32(self._vec_cap)
                 bv = bv & ((head & fits) | (jnp.arange(Kb) == 0))
                 s2 = self._stall_split_batch(s, tops_k, bv, feature_mask)
                 nsp = jnp.sum(bv, dtype=jnp.int32).astype(jnp.int32)
@@ -1331,6 +1384,11 @@ class WaveTPUTreeLearner(CompactTPUTreeLearner):
                 jnp.asarray(0, jnp.int32))
         (st, avail_n, refidx, pops, leaf_cnt, poprec, stalls, _extras,
          _) = lax.while_loop(outer_cond, outer_body, init)
+        if st.telem is not None:
+            st = st._replace(telem=st.telem
+                             .at[TEL_STALL_SPLITS].set(stalls)
+                             .at[TEL_STALL_EXTRAS].set(_extras)
+                             .at[TEL_POPS].set(pops))
         pop_nodes, pop_ref = poprec[:, 0], poprec[:, 1]
         # final frontier = revealed (root or child of a popped node) and
         # never popped — reconstructed from the pop list
@@ -1347,6 +1405,7 @@ class WaveTPUTreeLearner(CompactTPUTreeLearner):
     # -- whole tree -----------------------------------------------------------
 
     def _train_tree_wave(self, bins_p, grad, hess, bag, feature_mask):
+        self._ledger.begin_trace()
         self._hist_branches = [self._make_hist_branch(S)
                                for S in self._win_sizes]
         self._stall_branches = [
@@ -1382,8 +1441,14 @@ class WaveTPUTreeLearner(CompactTPUTreeLearner):
         """Exact greedy replay + host-record emission + speculative-leaf
         mapping (shared by the serial and sharded wave learners — the
         replay operates on replicated node state only)."""
+        if st.telem is not None:
+            st = st._replace(
+                telem=st.telem.at[TEL_GROW_SPLITS].set(st.num_splits))
         st, avail, refidx, pops, pop_nodes, pop_ref, _stalls = self._replay(
             st, feature_mask)
+        if st.telem is not None:
+            st = st._replace(
+                telem=st.telem.at[TEL_TOTAL_SPLITS].set(st.num_splits))
 
         # ---- emit host records in pop order
         budget = self.budget
@@ -1437,25 +1502,43 @@ class WaveTPUTreeLearner(CompactTPUTreeLearner):
         leaf_out = jnp.zeros(self.num_leaves, jnp.float32).at[
             jnp.where(final, refidx, self.num_leaves + 7)].set(
                 st.node_f[:, LF_OUT].astype(jnp.float32))
+        if st.telem is not None:
+            return rec_f, rec_i, rec_cat, leaf_id, leaf_out, st.telem
         return rec_f, rec_i, rec_cat, leaf_id, leaf_out
 
     # -- host orchestration ---------------------------------------------------
+
+    def memory_gauges(self) -> dict:
+        """Working-set byte breakdown for the telemetry report — the SAME
+        formula the eligibility gate uses (``wave_transient_bytes``), over
+        this learner's actual (bundled / local-shard) dimensions."""
+        return wave_transient_bytes(self.cfg, self._rows_len(),
+                                    self.fw * 4, self._hist_nbins)
+
+    def _pop_telem(self, out):
+        """Strip the trailing device counter vector off a tree program's
+        outputs (stashed for ``take_telemetry``); identity when telemetry
+        is off, so every caller keeps its 5-tuple contract."""
+        if self._telemetry:
+            self._last_telem = out[5]
+            return out[:5]
+        return out
 
     def train_async(self, grad: jax.Array, hess: jax.Array, bag: jax.Array,
                     feature_mask: Optional[jax.Array] = None):
         if feature_mask is None:
             feature_mask = jnp.ones(self.num_features, dtype=bool)
-        return self._jit_tree_w(self.bins_packed(), grad, hess, bag,
-                                feature_mask)
+        return self._pop_telem(self._jit_tree_w(
+            self.bins_packed(), grad, hess, bag, feature_mask))
 
 
-def wave_budget_reason(cfg: Config, n_pad: int, f_pad: int, b: int
-                       ) -> Optional[str]:
-    """Shape/byte-budget gates shared by the serial and sharded wave
-    learners (``n_pad`` is the PER-DEVICE row count for sharded use)."""
-    if f_pad // 4 > 64:
-        return f"{f_pad} padded columns > 256 (per-row word extraction is " \
-               "a masked sum over words)"
+def wave_transient_bytes(cfg: Config, n_pad: int, f_pad: int, b: int
+                         ) -> dict:
+    """Working-set byte breakdown of the wave learner (``n_pad`` is the
+    PER-DEVICE row count for sharded use).  Single source of truth for
+    ``wave_budget_reason``'s gate AND the telemetry memory gauge
+    (``WaveTPUTreeLearner.memory_gauges``) — the budget decision and the
+    reported gauge can never disagree."""
     budget = max(int(cfg.num_leaves), 2) - 1
     W = min(int(cfg.tpu_wave_width), budget)
     grow = min(budget + int(np.ceil(budget
@@ -1474,7 +1557,33 @@ def wave_budget_reason(cfg: Config, n_pad: int, f_pad: int, b: int
     lookup_bytes = min(n_pad, 1 << 17) * m_pad * 4
     # double-buffered sort operands (key + fw words + 3 weights + rid + lid)
     sort_bytes = 2 * (f_pad // 4 + 6) * n_pad * 4
-    total = h_bytes + scan_bytes + mask_bytes + lookup_bytes + sort_bytes
+    # batched replay correction: the vectorized partition stacks the K-1
+    # extras' (fw, S) bin-word + (3, S) weight + (S,) lid slices, S up to
+    # the vec cap — on wide datasets (fw in the hundreds) this per-event
+    # transient is material and must count against the budget (round-5
+    # advisor, low)
+    k = max(1, min(int(getattr(cfg, "tpu_wave_stall_batch", 4)), 16))
+    vc = int(getattr(cfg, "tpu_wave_vec_cap", -1))
+    if vc <= 0:
+        vc = WaveTPUTreeLearner._VEC_CAP
+    stall_vec_bytes = 0 if k == 1 else \
+        (k - 1) * min(vc, n_pad) * (f_pad // 4 + 4) * 4
+    out = {"hist_pool_bytes": h_bytes, "child_scan_bytes": scan_bytes,
+           "wave_mask_bytes": mask_bytes, "leaf_lookup_bytes": lookup_bytes,
+           "sort_buffer_bytes": sort_bytes,
+           "stall_vec_bytes": stall_vec_bytes}
+    out["total_bytes"] = sum(out.values())
+    return out
+
+
+def wave_budget_reason(cfg: Config, n_pad: int, f_pad: int, b: int
+                       ) -> Optional[str]:
+    """Shape/byte-budget gates shared by the serial and sharded wave
+    learners (``n_pad`` is the PER-DEVICE row count for sharded use)."""
+    if f_pad // 4 > 64:
+        return f"{f_pad} padded columns > 256 (per-row word extraction is " \
+               "a masked sum over words)"
+    total = wave_transient_bytes(cfg, n_pad, f_pad, b)["total_bytes"]
     if total > int(cfg.tpu_wave_max_bytes):
         return "estimated working set %.1f GB > tpu_wave_max_bytes %.1f GB" \
             % (total / 2**30, int(cfg.tpu_wave_max_bytes) / 2**30)

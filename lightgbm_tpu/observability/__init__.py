@@ -1,0 +1,32 @@
+"""Structured training telemetry.
+
+The perf trajectory so far (BENCH_r01-r05) was driven by one-off scripts
+under ``profiling/`` and hand-done ablation arithmetic; the library itself
+measured nothing.  This package is the first-class observability layer the
+boosting loop and tree learners report through:
+
+  * ``Telemetry`` — host wall timers per phase, per-iteration timing, and
+    the host-side decode of the per-tree device counter vector the wave
+    learner accumulates on device (``learner_wave.TEL_*``).  The counter
+    vector rides the SAME ``copy_to_host_async`` flush as the per-tree
+    record arrays, so enabling telemetry adds zero host syncs to the hot
+    path; with ``telemetry=False`` the learners trace the exact same jaxpr
+    as before (the counter lane is ``None`` and never enters the program).
+  * ``CollectiveLedger`` — trace-time accounting of every collective the
+    sharded learners issue (op, payload bytes, phase, cadence).  Dynamic
+    per-tree totals are estimated by combining the static sites with the
+    decoded wave/stall counters.
+  * ``report`` — the JSON report schema (``schema.json``, checked in and
+    validated by the tier-1 smoke test) plus a dependency-free validator.
+
+Device-side *time* attribution inside the fused tree program is out of
+scope for counters — that is what the opt-in ``profile_trace_dir``
+(`jax.profiler`) trace is for; see README "Telemetry & profiling".
+"""
+
+from .collectives import CollectiveLedger
+from .report import load_schema, validate_report, write_report
+from .telemetry import TEL_NAMES, Telemetry
+
+__all__ = ["Telemetry", "CollectiveLedger", "TEL_NAMES",
+           "load_schema", "validate_report", "write_report"]

@@ -1,0 +1,63 @@
+"""Trace-time collective-communication accounting.
+
+The sharded learners (`lightgbm_tpu/parallel/`) issue their collectives
+from a handful of seams (``_reduce_hist`` / ``_reduce_hist_batch`` /
+``_sync_counts*`` / ``_global_scalar`` / the best-split all_gathers).
+Those seams run as plain Python during jit tracing, so each call site can
+record (op, payload bytes, phase, cadence) HERE with zero runtime cost —
+the ledger never touches the compiled program.
+
+A site inside a ``lax.while_loop`` body traces once but executes once per
+loop iteration; the ``cadence`` tag ("tree" / "wave" / "stall_event" /
+"split") names that multiplier, and ``Telemetry`` combines it with the
+decoded per-tree wave/stall counters to estimate dynamic per-tree totals.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, List
+
+
+def _nbytes(x: Any) -> int:
+    """Payload bytes of an array/tracer (static shapes under jit)."""
+    if isinstance(x, (int, float)):
+        return int(x)
+    try:
+        return int(x.size) * int(x.dtype.itemsize)
+    except Exception:
+        return 0
+
+
+class CollectiveLedger:
+    """Per-learner registry of collective call sites (trace-time)."""
+
+    def __init__(self, enabled: bool = True):
+        self.enabled = bool(enabled)
+        self._sites: List[Dict[str, Any]] = []
+        self._keys = set()
+
+    def begin_trace(self) -> None:
+        """Reset at the top of a traced tree program so a re-trace (new
+        shape signature, rebuilt jit) doesn't double-count sites."""
+        if self.enabled:
+            self._sites = []
+            self._keys = set()
+
+    def record(self, op: str, payload: Any, phase: str,
+               cadence: str) -> None:
+        """Register one collective call site.  ``payload`` is the operand
+        (bytes read from its static shape) or an explicit byte count."""
+        if not self.enabled:
+            return
+        b = _nbytes(payload)
+        key = (op, phase, cadence, b)
+        if key in self._keys:
+            # the same seam traced again for another window bucket /
+            # cond branch — one site per distinct (op, phase, bytes)
+            return
+        self._keys.add(key)
+        self._sites.append({"op": op, "phase": phase, "cadence": cadence,
+                            "bytes_per_call": b})
+
+    def sites(self) -> Iterable[Dict[str, Any]]:
+        return list(self._sites)

@@ -164,14 +164,27 @@ class ShardedCompactLearner(CompactTPUTreeLearner):
         """Histogram exchange: reduce-scatter over the feature axis so each
         device sums (and later scans) a feature slice
         (`data_parallel_tree_learner.cpp:146-161`)."""
+        self._rec_coll("psum_scatter", local_hist)
         return lax.psum_scatter(local_hist, self.axis, scatter_dimension=0,
                                 tiled=True)
 
+    def _reduce_hist_batch(self, local_hists):
+        """Batched (K, F, B, 3) member histograms exchanged in ONE
+        collective (scatter over the feature axis), mirroring the wave
+        body's single psum_scatter per wave — K per-member exchanges
+        would pay K collective latencies per stall event."""
+        self._rec_coll("psum_scatter", local_hists)
+        return lax.psum_scatter(local_hists, self.axis,
+                                scatter_dimension=1, tiled=True)
+
     def _sync_counts(self, lc_bag, c_bag):
         """Global bagged counts from the local partition's sums."""
+        self._rec_coll("psum", lc_bag)
+        self._rec_coll("psum", c_bag)
         return (lax.psum(lc_bag, self.axis), lax.psum(c_bag, self.axis))
 
     def _global_scalar(self, v):
+        self._rec_coll("psum", v)
         return lax.psum(v, self.axis)
 
     def _child_best_rows(self, hist_left, hist_right, crow_f, fmask_pad,
@@ -299,6 +312,8 @@ class ShardedCompactLearner(CompactTPUTreeLearner):
                 lambda h, g, hh, c: one(h, g, hh, c, None, None)
             )(hist2, sg2, sh2, cn2)
         # global winner per child (tiny allgather)
+        for x in (cf, ci, cb):
+            self._rec_coll("all_gather", x)
         cf_all = lax.all_gather(cf, self.axis)     # (D, K, NUM_CF)
         ci_all = lax.all_gather(ci, self.axis)
         cb_all = lax.all_gather(cb, self.axis)
@@ -317,6 +332,8 @@ class ShardedCompactLearner(CompactTPUTreeLearner):
 
     def _train_tree_sharded(self, bins_p, grad, hess, bag, fmask_pad):
         """Body under shard_map: all row-axis arrays are LOCAL shards."""
+        self._ledger.begin_trace()
+        self._coll_ctx = ("root", "tree")
         axis = self.axis
         n, L = self.n_local, self.num_leaves
         b = self.num_bins_padded
@@ -481,6 +498,10 @@ class ShardedVotingLearner(ShardedCompactLearner):
         # the pool stays LOCAL; reduction happens per elected feature set
         return local_hist
 
+    def _reduce_hist_batch(self, local_hists):
+        # likewise: the batched stall-correction histograms stay local
+        return local_hists
+
     def _forced_hrow(self, state, fs, sum_g, sum_h, cnt):
         # the voting pool is full-width LOCAL-unreduced: reduce the one
         # forced feature's row across devices, then fix it
@@ -505,6 +526,8 @@ class ShardedVotingLearner(ShardedCompactLearner):
                 self.fp_default_bin, self.fp_is_cat, fmask_pad,
                 self.fp_monotone, self.fp_penalty)
             vals, votes = lax.top_k(g_loc, self.k_vote)       # (k,)
+            self._rec_coll("all_gather", votes)
+            self._rec_coll("all_gather", vals)
             all_votes = lax.all_gather(votes, self.axis).reshape(-1)
             all_valid = ~jnp.isneginf(
                 lax.all_gather(vals, self.axis).reshape(-1))
@@ -516,6 +539,7 @@ class ShardedVotingLearner(ShardedCompactLearner):
             sel = jnp.sort(lax.top_k(score, self.k2)[1]).astype(jnp.int32)
             # ---- CopyLocalHistogram: exchange only elected features
             sel_hist = hist[sel]                              # (k2, B, 3)
+            self._rec_coll("psum_scatter", sel_hist)
             sel_hist = lax.psum_scatter(sel_hist, self.axis,
                                         scatter_dimension=0, tiled=True)
             my_sel = lax.dynamic_slice_in_dim(sel, d * self.k2s, self.k2s)

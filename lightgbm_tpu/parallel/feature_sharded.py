@@ -93,6 +93,9 @@ class FeatureShardedCompactLearner(ShardedCompactLearner):
     def _reduce_hist(self, local_hist):
         return local_hist                   # hist IS the local slice
 
+    def _reduce_hist_batch(self, local_hists):
+        return local_hists                  # feature slices need no exchange
+
     def _make_hist_branch_shard(self, S: int):
         """Windowed histogram over THIS device's feature-word slice of the
         replicated packed bins."""
@@ -181,6 +184,7 @@ class FeatureShardedWaveLearner(FeatureShardedCompactLearner,
     # the hist branches compute this device's feature slice) — no override
 
     def _train_tree_feature_wave(self, bins_p, grad, hess, bag, fmask_pad):
+        self._ledger.begin_trace()
         self._hist_branches = [self._make_hist_branch_shard(S)
                                for S in self._win_sizes]
         self._stall_branches = [
@@ -209,9 +213,12 @@ class FeatureShardedWaveLearner(FeatureShardedCompactLearner,
             feature_mask)
         if self._jit_tree_w is None:
             ax = self.axis
+            out_specs = (P(), P(), P(), P(), P())
+            if self._telemetry:
+                out_specs = out_specs + (P(),)
             kw = dict(mesh=self.mesh,
                       in_specs=(P(None, None), P(), P(), P(), P()),
-                      out_specs=(P(), P(), P(), P(), P()))
+                      out_specs=out_specs)
             try:
                 fn = shard_map(self._train_tree_feature_wave,
                                check_vma=False, **kw)
@@ -219,8 +226,8 @@ class FeatureShardedWaveLearner(FeatureShardedCompactLearner,
                 fn = shard_map(self._train_tree_feature_wave,
                                check_rep=False, **kw)
             self._jit_tree_w = jax.jit(fn)
-        return self._jit_tree_w(self.sharded_bins(), grad, hess, bag,
-                                fmask_pad)
+        return self._pop_telem(self._jit_tree_w(
+            self.sharded_bins(), grad, hess, bag, fmask_pad))
 
     def lowered_hlo_text(self) -> str:
         z = jnp.zeros(self.n_pad, jnp.float32)

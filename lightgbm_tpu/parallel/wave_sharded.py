@@ -66,8 +66,16 @@ class ShardedWaveLearner(ShardedCompactLearner, WaveTPUTreeLearner):
     def _sync_counts3(self, cnt3):
         # row 0 (left ROW count) is local window geometry; rows 1-2 are
         # the global bagged counts every device must agree on
+        self._rec_coll("psum", cnt3[1:])
         bagged = lax.psum(cnt3[1:], self.axis)
         return jnp.concatenate([cnt3[:1], bagged], axis=0)
+
+    def _replicated_spans(self, spans):
+        # phys_i spans are LOCAL row-window geometry here — replicate the
+        # batched-stall gate with the cross-device max so bv (and the
+        # whole replay bookkeeping) stays identical on every shard
+        self._rec_coll("pmax", spans)
+        return lax.pmax(spans, self.axis)
 
     def _cand_rows_batch(self, hists, sg, sh, cn, feature_mask, depth_ok,
                          constraints):
@@ -98,6 +106,7 @@ class ShardedWaveLearner(ShardedCompactLearner, WaveTPUTreeLearner):
         _, h_local = lax.scan(hist_member, 0,
                               (sm_slot, sm_start, sm_cnt, valid))
         # (W, f_pad, B, 3) -> (W, fs, B, 3): one collective per wave
+        self._rec_coll("psum_scatter", h_local)
         h_small = lax.psum_scatter(h_local, self.axis, scatter_dimension=1,
                                    tiled=True)
         h_par = st.hist_pool[ph]                       # (W, fs, B, 3)
@@ -115,6 +124,7 @@ class ShardedWaveLearner(ShardedCompactLearner, WaveTPUTreeLearner):
     # -- the sharded wave tree ----------------------------------------------
 
     def _train_tree_wave_sharded(self, bins_p, grad, hess, bag, fmask_pad):
+        self._ledger.begin_trace()
         self._hist_branches = [self._make_hist_branch_shard(S)
                                for S in self._win_sizes]
         self._stall_branches = [
@@ -143,9 +153,12 @@ class ShardedWaveLearner(ShardedCompactLearner, WaveTPUTreeLearner):
             feature_mask)
         if self._jit_tree_w is None:
             ax = self.axis
+            out_specs = (P(), P(), P(), P(ax), P())
+            if self._telemetry:  # the counter lane is replicated bookkeeping
+                out_specs = out_specs + (P(),)
             kw = dict(mesh=self.mesh,
                       in_specs=(P(None, ax), P(ax), P(ax), P(ax), P()),
-                      out_specs=(P(), P(), P(), P(ax), P()))
+                      out_specs=out_specs)
             try:
                 fn = shard_map(self._train_tree_wave_sharded,
                                check_vma=False, **kw)
@@ -153,8 +166,8 @@ class ShardedWaveLearner(ShardedCompactLearner, WaveTPUTreeLearner):
                 fn = shard_map(self._train_tree_wave_sharded,
                                check_rep=False, **kw)
             self._jit_tree_w = jax.jit(fn)
-        return self._jit_tree_w(self.sharded_bins(), grad, hess, bag,
-                                fmask_pad)
+        return self._pop_telem(self._jit_tree_w(
+            self.sharded_bins(), grad, hess, bag, fmask_pad))
 
     def lowered_hlo_text(self) -> str:
         n = self.n_pad
@@ -184,6 +197,11 @@ class ShardedVotingWaveLearner(ShardedWaveLearner):
     def _reduce_hist(self, local_hist):
         # the pool stays LOCAL; reduction happens per elected feature set
         return local_hist
+
+    def _reduce_hist_batch(self, local_hists):
+        # batched stall-correction histograms stay local too (the voting
+        # protocol reduces only elected features inside the candidate scan)
+        return local_hists
 
     def _wave_member_hists(self, st, sm_slot, sm_start, sm_cnt, valid, ph,
                            lh_w, rh_w, left_small):
