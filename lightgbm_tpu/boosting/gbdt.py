@@ -261,6 +261,7 @@ class GBDT:
         self._stopped = False
         self._model_version = 0          # bumped on in-place tree mutation
         self._device_predictor = None    # (key, DevicePredictor) cache
+        self._pred_schema = None         # 1-tuple cache (loaded boosters)
         self._jit_grad_fn = None
         self._lr_dev = None
         self._lr_dev_val = None
@@ -851,13 +852,18 @@ class GBDT:
         num_models = self._num_models_for(num_iteration)
         cfg = self.cfg
         # device batch predictor (`predictor.py`): exact bin-space traversal
-        # of all trees in one scan — needs the training mappers; text-loaded
-        # boosters without a bound dataset use the host path below
-        # the device predictor packs INNER (bin-space) tree fields — trees
-        # pending a rebind (refit/continue-training on a new dataset) must
-        # not take this path until rebound
-        use_device = (self.train_data is not None and num_models > 0
-                      and (n * num_models >= 200_000 or cfg.pred_early_stop)
+        # of all trees in one scan.  Trained boosters bin against the
+        # training mappers; text-loaded boosters get a synthetic bin schema
+        # reconstructed from the model text (thresholds become bounds —
+        # `predictor.reconstruct_bin_schema`), so they serve on device too.
+        # Trees pending a rebind (refit/continue-training on a NEW dataset)
+        # must not take this path until rebound.
+        big = num_models > 0 and (n * num_models >= 200_000
+                                  or cfg.pred_early_stop)
+        pred_data = self.train_data
+        if pred_data is None and big:
+            pred_data = self._prediction_schema()
+        use_device = (pred_data is not None and big
                       and not any(getattr(t, "needs_rebind", False)
                                   for t in self.models[:num_models]))
         if use_device:
@@ -867,7 +873,7 @@ class GBDT:
             if self._device_predictor is None \
                     or self._device_predictor[0] != key:
                 self._device_predictor = (key, DevicePredictor(
-                    self, self.train_data, num_iteration,
+                    self, pred_data, num_iteration,
                     pred_early_stop=cfg.pred_early_stop,
                     pred_early_stop_freq=cfg.pred_early_stop_freq,
                     pred_early_stop_margin=cfg.pred_early_stop_margin))
@@ -877,6 +883,22 @@ class GBDT:
         for i in range(num_models):
             out[:, i % k] += self.models[i].predict(X)
         return out[:, 0] if k == 1 else out
+
+    def _prediction_schema(self):
+        """Synthetic bin schema for a dataset-less (text-loaded) booster,
+        built once and cached; ``None`` when reconstruction isn't possible
+        (the host numpy path still serves those)."""
+        if self._pred_schema is None:
+            from ..predictor import reconstruct_bin_schema
+            try:
+                self._pred_schema = (reconstruct_bin_schema(self),)
+            except Exception as e:  # unexpected model text shapes
+                import warnings
+                warnings.warn("could not reconstruct a device bin schema "
+                              f"from the model text ({e}); predictions use "
+                              "the host path")
+                self._pred_schema = (None,)
+        return self._pred_schema[0]
 
     def predict(self, X: np.ndarray, num_iteration: int = -1,
                 raw_score: bool = False, pred_leaf: bool = False) -> np.ndarray:
@@ -969,8 +991,26 @@ class GBDT:
 
     def save_model_to_file(self, filename: str, start_iteration: int = 0,
                            num_iteration: int = -1) -> None:
-        with open(filename, "w") as fh:
-            fh.write(self.save_model_to_string(start_iteration, num_iteration))
+        """Atomic write: tempfile in the target directory + ``os.replace``,
+        so a preemption mid-write (snapshot_iter_* checkpoints especially)
+        never leaves a truncated model behind."""
+        import os
+        import tempfile
+
+        s = self.save_model_to_string(start_iteration, num_iteration)
+        d = os.path.dirname(os.path.abspath(filename))
+        fd, tmp = tempfile.mkstemp(
+            prefix=os.path.basename(filename) + ".", suffix=".tmp", dir=d)
+        try:
+            with os.fdopen(fd, "w") as fh:
+                fh.write(s)
+            os.replace(tmp, filename)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
 
     # -- JSON dump (`gbdt_model_text.cpp:15-60` DumpModel) -------------------
 

@@ -12,6 +12,10 @@ and the four tasks
                               data (`gbdt.cpp` RefitTree)
   * ``task=convert_model``  — model text → C++ if-else source
                               (`gbdt_model_text.cpp` SaveModelToIfElse)
+  * ``task=serve``          — long-lived prediction service over
+                              ``input_model`` (`lightgbm_tpu/serving/`);
+                              also reachable as the bare subcommand
+                              ``python -m lightgbm_tpu serve ...``
 
 Run the reference's own ``examples/*/train.conf`` unmodified from the
 example's directory.
@@ -38,7 +42,10 @@ def _load_params(argv: List[str]) -> Dict[str, str]:
     i = 0
     while i < len(argv):
         tok = argv[i]
-        if tok.startswith("--"):
+        if tok in _TASKS and "task" not in cmdline:
+            # subcommand style: `python -m lightgbm_tpu serve model.conf ...`
+            cmdline["task"] = tok
+        elif tok.startswith("--"):
             key = tok[2:].replace("-", "_")
             if "=" in key:
                 key, v = key.split("=", 1)
@@ -63,7 +70,7 @@ def _load_params(argv: List[str]) -> Dict[str, str]:
 
 
 def _log(msg: str) -> None:
-    print(f"[LightGBM-TPU] [Info] {msg}")
+    print(f"[LightGBM-TPU] [Info] {msg}", flush=True)
 
 
 def run_train(params: Dict[str, str], cfg: Config) -> None:
@@ -159,18 +166,54 @@ def run_convert_model(params: Dict[str, str], cfg: Config) -> None:
     _save_if_else(booster, cfg.convert_model)
 
 
+def run_serve(params: Dict[str, str], cfg: Config) -> None:
+    """``task=serve``: micro-batched prediction service over a saved model
+    (`lightgbm_tpu/serving/`).  Blocks until a client sends ``shutdown``
+    or the process receives SIGINT; ``--telemetry-out`` writes the serving
+    telemetry report (``serving`` section of observability/schema.json)
+    on exit."""
+    from .engine import Booster
+
+    if not cfg.input_model:
+        raise ValueError("task=serve requires input_model")
+    booster = Booster(model_file=cfg.input_model, params=dict(params))
+    server = booster.serve(
+        host=cfg.serve_host, port=cfg.serve_port,
+        max_batch_rows=cfg.serve_max_batch_rows,
+        deadline_ms=cfg.serve_deadline_ms,
+        min_bucket=cfg.serve_min_bucket, warmup=cfg.serve_warmup,
+        telemetry_out=cfg.telemetry_out)
+    _log(f"Serving {cfg.input_model} at {server.host}:{server.port} "
+         f"(buckets {server.buckets}, deadline {cfg.serve_deadline_ms} ms)")
+    try:
+        server.wait()
+    except KeyboardInterrupt:
+        _log("Interrupted, shutting down")
+    finally:
+        server.stop()
+    if cfg.telemetry_out:
+        _log(f"Serving telemetry report written to {cfg.telemetry_out}")
+    _log("Finished serving")
+
+
+_TASKS = {"train": "run_train", "refit_tree": "run_refit",
+          "refit": "run_refit", "predict": "run_predict",
+          "prediction": "run_predict", "test": "run_predict",
+          "convert_model": "run_convert_model", "serve": "run_serve"}
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     params = _load_params(argv)
     cfg = Config.from_params(params)
-    if not cfg.data and cfg.task != "convert_model":
+    if not cfg.data and cfg.task not in ("convert_model", "serve"):
         print("[LightGBM-TPU] [Fatal] No training/prediction data, "
               "application quit", file=sys.stderr)
         return 1
     task = {"train": run_train, "refit_tree": run_refit, "refit": run_refit,
             "predict": run_predict, "prediction": run_predict,
-            "test": run_predict, "convert_model": run_convert_model
-            }.get(cfg.task)
+            "test": run_predict, "convert_model": run_convert_model,
+            "serve": run_serve}.get(cfg.task)
     if task is None:
         print(f"[LightGBM-TPU] [Fatal] Unknown task: {cfg.task}",
               file=sys.stderr)

@@ -383,6 +383,21 @@ class Config:
     # span cap (_VEC_CAP, default 2^17 rows).  Tests shrink it so the
     # replicated span gate is exercised at CI problem sizes
     tpu_wave_vec_cap: int = -1
+    # --- serving (lightgbm_tpu/serving/) ---
+    # `task=serve` / `python -m lightgbm_tpu serve`: bind address and port
+    # (0 = ephemeral, the bound port is logged at startup)
+    serve_host: str = "127.0.0.1"
+    serve_port: int = 12500
+    # micro-batch row budget; requests coalesce up to this many rows and
+    # pad to power-of-two buckets so every shape hits a warm jit cache
+    serve_max_batch_rows: int = 1024
+    # how long the batcher waits for more requests after the first arrives
+    serve_deadline_ms: float = 2.0
+    # smallest padded row bucket (the floor of the power-of-two ladder)
+    serve_min_bucket: int = 32
+    # compile every bucket shape at startup so the request path never
+    # recompiles; disable only for debugging
+    serve_warmup: bool = True
     # replay stall correction batch: when the exact greedy replay reaches
     # a leaf the speculative growth never split, split up to this many of
     # the highest-priority unsplit frontier leaves in ONE correction pass

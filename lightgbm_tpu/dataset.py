@@ -414,6 +414,7 @@ class Dataset:
         a.max_num_bin = max(a.max_num_bin, b.max_num_bin)
         a._device_bins = None
         a._feature_meta = None
+        a._binner_arrays = None
         return self
 
 
@@ -623,6 +624,16 @@ class _ConstructedDataset:
     @property
     def num_used_features(self) -> int:
         return len(self.bin_mappers)
+
+    def binner_arrays(self):
+        """Padded per-feature boundary/LUT arrays for the vectorized
+        predict binner (`serving/binner.py`): boundary rows for the
+        device ``searchsorted``, category LUT rows, missing metadata.
+        Cached — serving and ``DevicePredictor.predict_raw`` share one
+        instance per dataset."""
+        from .serving.binner import BinnerArrays
+
+        return BinnerArrays.for_data(self)
 
     def feature_meta_arrays(self):
         """Static per-feature metadata as numpy arrays for the split finder:

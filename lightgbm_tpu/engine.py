@@ -232,6 +232,22 @@ class Booster:
         README "Telemetry & profiling" and observability/schema.json)."""
         return self.gbdt.get_telemetry(light=light)
 
+    # -- serving (lightgbm_tpu/serving/) -------------------------------------
+
+    def to_server(self, **kwargs) -> "Any":
+        """An UNSTARTED ``PredictionServer`` with this booster registered
+        as the ``default`` model (see README "Serving").  Keyword args are
+        forwarded (host/port/max_batch_rows/deadline_ms/min_bucket/
+        warmup/telemetry_out)."""
+        from .serving import PredictionServer
+
+        return PredictionServer(booster=self, **kwargs)
+
+    def serve(self, **kwargs) -> "Any":
+        """Start serving this booster over a socket; returns the running
+        ``PredictionServer`` (``.host``/``.port``/``.stop()``)."""
+        return self.to_server(**kwargs).start()
+
     def feature_name(self) -> List[str]:
         return list(self.gbdt.feature_names)
 

@@ -32,25 +32,38 @@ _LEN = struct.Struct("<Q")
 _HDR = struct.Struct("<iq")          # (rank, seq)
 
 
-def _send_msg(sock: socket.socket, rank: int, seq: int, payload) -> None:
-    blob = pickle.dumps(payload, protocol=pickle.HIGHEST_PROTOCOL)
-    sock.sendall(_HDR.pack(rank, seq) + _LEN.pack(len(blob)) + blob)
-
-
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
     buf = bytearray()
     while len(buf) < n:
         chunk = sock.recv(n - len(buf))
         if not chunk:
-            raise ConnectionError("peer closed during collective")
+            raise ConnectionError("peer closed mid-message")
         buf.extend(chunk)
     return bytes(buf)
 
 
+# -- generic length-prefixed pickle frames (shared with `serving/server.py`) -
+
+def send_frame(sock: socket.socket, payload) -> None:
+    """8-byte little-endian length + pickle — the wire unit every protocol
+    in this package (collectives AND the serving RPC) is built from."""
+    blob = pickle.dumps(payload, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(_LEN.pack(len(blob)) + blob)
+
+
+def recv_frame(sock: socket.socket):
+    (ln,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+    return pickle.loads(_recv_exact(sock, ln))
+
+
+def _send_msg(sock: socket.socket, rank: int, seq: int, payload) -> None:
+    sock.sendall(_HDR.pack(rank, seq))
+    send_frame(sock, payload)
+
+
 def _recv_msg(sock: socket.socket) -> Tuple[int, int, object]:
     rank, seq = _HDR.unpack(_recv_exact(sock, _HDR.size))
-    (ln,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
-    return rank, seq, pickle.loads(_recv_exact(sock, ln))
+    return rank, seq, recv_frame(sock)
 
 
 class SocketNet:

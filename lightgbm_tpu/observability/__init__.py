@@ -18,6 +18,9 @@ boosting loop and tree learners report through:
     decoded wave/stall counters.
   * ``report`` — the JSON report schema (``schema.json``, checked in and
     validated by the tier-1 smoke test) plus a dependency-free validator.
+    Schema v2 adds the optional ``serving`` section that the prediction
+    service (`lightgbm_tpu/serving/`) reports QPS, queue/bin/traverse/unpad
+    stage latency, batch occupancy and compile-cache hits through.
 
 Device-side *time* attribution inside the fused tree program is out of
 scope for counters — that is what the opt-in ``profile_trace_dir``

@@ -40,7 +40,9 @@ TEL_NAMES = {
     TEL_TOTAL_SPLITS: "total_splits",
 }
 
-SCHEMA_VERSION = 1
+# v2: optional "serving" section (QPS / stage latency / batch occupancy /
+# compile-cache — `lightgbm_tpu/serving/batcher.py` ServingStats.report)
+SCHEMA_VERSION = 2
 
 
 class Telemetry:

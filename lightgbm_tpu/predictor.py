@@ -21,6 +21,7 @@ under DART reweighting) does not recompile.
 from __future__ import annotations
 
 import functools
+import math
 from typing import List
 
 import jax
@@ -200,14 +201,144 @@ class DevicePredictor:
 
     def predict_raw(self, X: np.ndarray) -> np.ndarray:
         """(n,) or (n, K) raw scores; X binned host-side with the model's
-        own mappers (raw-prediction semantics for categoricals)."""
-        n = X.shape[0]
-        fu = self.data.num_used_features
-        f_pad = self.data.bins.shape[0]
-        bins = np.zeros((f_pad, n), dtype=np.int32)
-        for k in range(fu):
-            j = int(self.data.used_feature_map[k])
-            bins[k] = self.data.bin_mappers[k].values_to_bins_predict(
-                X[:, j], self.OOV_BIN)
+        own mappers (raw-prediction semantics for categoricals) through the
+        vectorized padded-array binner (`serving/binner.py` — golden parity
+        with the per-feature ``values_to_bins_predict`` loop it replaced)."""
+        from .serving.binner import BinnerArrays
+
+        bins = BinnerArrays.for_data(self.data).bin_host(X)
         score = np.asarray(self.predict_binned(jnp.asarray(bins)))
         return score[0] if self.K == 1 else score.T
+
+
+class PredictionBinSchema:
+    """Duck-typed stand-in for ``_ConstructedDataset`` covering exactly the
+    surface the device predictor and binner read: ``bin_mappers``,
+    ``used_feature_map``, ``feature_meta_arrays`` and the padded feature
+    count.  Built by ``reconstruct_bin_schema`` for boosters loaded from
+    model text (no training data attached)."""
+
+    FEATURE_TILE = 8  # match _ConstructedDataset's feature-axis padding
+
+    def __init__(self, bin_mappers, used_feature_map):
+        self.bin_mappers = list(bin_mappers)
+        self.used_feature_map = np.asarray(used_feature_map, dtype=np.int32)
+        fu = len(self.bin_mappers)
+        f_pad = ((max(fu, 1) + self.FEATURE_TILE - 1)
+                 // self.FEATURE_TILE) * self.FEATURE_TILE
+        # shape carrier only — the schema never holds binned rows
+        self.bins = np.zeros((f_pad, 0), dtype=np.uint16)
+        self._feature_meta = None
+        self._binner_arrays = None
+
+    @property
+    def num_used_features(self) -> int:
+        return len(self.bin_mappers)
+
+    def feature_meta_arrays(self):
+        if self._feature_meta is None:
+            from .binning import BIN_CATEGORICAL
+            num_bin = np.array([m.num_bin for m in self.bin_mappers],
+                               dtype=np.int32)
+            missing = np.array([m.missing_type for m in self.bin_mappers],
+                               dtype=np.int32)
+            default_bin = np.array([m.default_bin for m in self.bin_mappers],
+                                   dtype=np.int32)
+            is_categorical = np.array([m.bin_type == BIN_CATEGORICAL
+                                       for m in self.bin_mappers], dtype=bool)
+            self._feature_meta = (num_bin, missing, default_bin,
+                                  is_categorical)
+        return self._feature_meta
+
+
+def reconstruct_bin_schema(gbdt) -> PredictionBinSchema:
+    """Rebuild a servable bin space for a text-loaded booster.
+
+    The model text carries raw thresholds, per-node missing semantics and
+    the categorical vocabularies (``feature_infos``) but not the training
+    bin boundaries.  For PREDICTION none of the boundaries between
+    thresholds matter: a synthetic mapper whose upper bounds are exactly
+    the feature's split thresholds (plus the ±kZeroThreshold pair when a
+    node uses zero-as-missing, plus the NaN bin when a node uses NaN
+    missing) reproduces raw traversal decisions bit-for-bit —
+    ``v <= t  ⇔  bin(v) <= bin(t)`` when every ``t`` is itself a bound.
+
+    Side effect: every tree is rebound into the synthetic bin space
+    (``split_feature_inner`` / ``threshold_in_bin`` / inner cat bitsets),
+    after which the booster serves on device like a freshly trained one.
+    """
+    from .binning import (BIN_CATEGORICAL, BIN_NUMERICAL, MISSING_NAN,
+                          MISSING_ZERO, BinMapper, kZeroThreshold)
+    from .boosting.gbdt import rebind_tree_to_dataset
+
+    models = gbdt.models
+    nfeat = int(gbdt.max_feature_idx) + 1
+    thresholds = [set() for _ in range(nfeat)]
+    bitset_cats = [set() for _ in range(nfeat)]
+    missing = [0] * nfeat
+    is_cat = [False] * nfeat
+    for t in models:
+        for nd in range(t.num_leaves - 1):
+            j = int(t.split_feature[nd])
+            dt = int(t.decision_type[nd])
+            missing[j] = max(missing[j], (dt >> 2) & 3)
+            if dt & 1:
+                is_cat[j] = True
+                cat_idx = int(t.threshold[nd])
+                lo, hi = t.cat_boundaries[cat_idx], \
+                    t.cat_boundaries[cat_idx + 1]
+                for w in range(lo, hi):
+                    word = int(t.cat_threshold[w])
+                    for b in range(32):
+                        if (word >> b) & 1:
+                            bitset_cats[j].add(32 * (w - lo) + b)
+            else:
+                thresholds[j].add(float(t.threshold[nd]))
+
+    # used features: the training-time non-trivial set when feature_infos
+    # is intact, else every feature the trees actually split on
+    infos = list(getattr(gbdt, "feature_infos", []) or [])
+    if len(infos) == nfeat:
+        used = [j for j in range(nfeat) if infos[j] != "none"]
+    else:
+        infos = ["none"] * nfeat
+        used = sorted(j for j in range(nfeat)
+                      if thresholds[j] or is_cat[j])
+
+    mappers = []
+    for j in used:
+        m = BinMapper()
+        m.missing_type = missing[j]
+        m.is_trivial = False
+        info = infos[j]
+        if is_cat[j] or (info not in ("none", "") and not
+                         info.startswith("[")):
+            m.bin_type = BIN_CATEGORICAL
+            if info not in ("none", "") and not info.startswith("["):
+                cats = [int(c) for c in info.split(":")]
+            else:
+                cats = sorted(bitset_cats[j])
+                if m.missing_type == MISSING_NAN:
+                    cats.append(-1)
+            m.bin_2_categorical = cats
+            m.categorical_2_bin = {c: i for i, c in enumerate(cats)}
+            m.num_bin = max(len(cats), 1)
+            m.default_bin = m.categorical_2_bin.get(0, m.num_bin - 1)
+        else:
+            m.bin_type = BIN_NUMERICAL
+            bounds = set(thresholds[j])
+            if m.missing_type == MISSING_ZERO:
+                bounds.update((-kZeroThreshold, kZeroThreshold))
+            bounds = sorted(bounds) + [math.inf]
+            if m.missing_type == MISSING_NAN:
+                bounds.append(math.nan)
+            m.bin_upper_bound = np.asarray(bounds, dtype=np.float64)
+            m.num_bin = len(bounds)
+            m.default_bin = int(m.value_to_bin(0.0))
+        mappers.append(m)
+
+    schema = PredictionBinSchema(mappers, used)
+    for t in models:
+        t.needs_rebind = True
+        rebind_tree_to_dataset(t, schema)
+    return schema

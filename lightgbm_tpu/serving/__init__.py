@@ -1,0 +1,52 @@
+"""TPU-resident serving subsystem.
+
+Everything past training used to be a one-shot path: ``predict_raw`` binned
+each request host-side in a per-feature Python loop and recompiled whenever
+the row count changed.  This package is the long-lived serving layer the
+ROADMAP north star ("serves heavy traffic from millions of users") needs:
+
+  * ``binner`` — the value→bin quantization of ``BinMapper`` re-expressed as
+    padded per-feature arrays (boundary rows for a vectorized
+    ``searchsorted``, category LUT rows) with one jitted device kernel and
+    one vectorized host variant.  Bit-parity with
+    ``BinMapper.values_to_bins_predict`` (OOV categoricals, NaN bins,
+    zero-as-missing) is the contract ``tests/test_serving.py`` pins.
+  * ``batcher`` — a deadline-based micro-batching queue: concurrent
+    requests coalesce into padded power-of-two row buckets so every shape
+    hits a warm jit cache; the request path never compiles (buckets are
+    compiled once, at warmup).
+  * ``registry`` — a versioned multi-model registry with atomic hot-swap:
+    a new model text is loaded, warmed and verified against the host
+    traversal while the old version keeps serving; failure rolls back by
+    simply never swapping.
+  * ``server`` — a threaded socket server + client over the
+    length-prefixed-pickle framing of ``io/net.py``, exposed as
+    ``python -m lightgbm_tpu serve`` and ``Booster.serve()``.
+
+Serving telemetry (QPS, queue/bin/traverse/unpad stage latency, batch
+occupancy, compile-cache hits) reports through ``observability/`` under the
+``serving`` section of ``schema.json``.
+"""
+
+from .binner import OOV_BIN, BinnerArrays
+
+_LAZY = {
+    "MicroBatcher": "batcher", "ServingStats": "batcher",
+    "ModelRegistry": "registry", "ServingModel": "registry",
+    "PredictionServer": "server", "ServingClient": "server",
+}
+
+__all__ = ["OOV_BIN", "BinnerArrays", "MicroBatcher", "ServingStats",
+           "ModelRegistry", "ServingModel", "PredictionServer",
+           "ServingClient"]
+
+
+def __getattr__(name):
+    # registry/server pull in the Booster facade — import lazily so that
+    # `import lightgbm_tpu.serving.binner` from the predictor stays light
+    mod = _LAZY.get(name)
+    if mod is None:
+        raise AttributeError(name)
+    import importlib
+
+    return getattr(importlib.import_module(f".{mod}", __name__), name)

@@ -1,0 +1,261 @@
+"""Deadline-based micro-batching queue + serving statistics.
+
+Concurrent prediction requests coalesce into one device dispatch: the worker
+collects requests until either the batch deadline elapses or the row budget
+fills, concatenates them, pads the row axis up to the nearest power-of-two
+bucket and runs the model's jitted bin+traverse pipeline.  Because every
+bucket shape was compiled at warmup, the request path NEVER compiles — the
+serving analogue of the training loop's static padded shapes
+(`dataset.py` row padding).
+
+Stage accounting (queue → bin → traverse → unpad) flows through a
+``ServingStats`` wrapping the same ``Telemetry`` accumulator training uses,
+and surfaces in the JSON report's ``serving`` section
+(``observability/schema.json``).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from ..observability import Telemetry
+
+
+def next_pow2(n: int) -> int:
+    return 1 << max(int(n) - 1, 0).bit_length()
+
+
+def bucket_ladder(min_bucket: int, max_rows: int) -> List[int]:
+    """The power-of-two row buckets between ``min_bucket`` and
+    ``max_rows`` inclusive — the shapes warmed at startup."""
+    lo, hi = next_pow2(min_bucket), next_pow2(max_rows)
+    out = []
+    b = lo
+    while b <= hi:
+        out.append(b)
+        b *= 2
+    return out
+
+
+class ServingStats:
+    """Thread-safe serving counters + stage phase timers.
+
+    Stage timers reuse ``Telemetry`` phases (named ``serve_<stage>``), so
+    they show up both in the standard ``phases`` section and, summarized,
+    under ``serving.stage_ms``.
+    """
+
+    STAGES = ("queue", "pad", "bin", "traverse", "unpad")
+
+    def __init__(self):
+        self.tel = Telemetry(True)
+        self._lock = threading.Lock()
+        self._t0 = time.monotonic()
+        self.requests = 0
+        self.rows = 0
+        self.batches = 0
+        self.batched_rows = 0
+        self.bucket_rows = 0
+        self.bucket_batches: Dict[int, int] = {}
+        self.cache_hits = 0
+        self.cache_misses = 0
+
+    def stage(self, name: str):
+        return self.tel.phase(f"serve_{name}")
+
+    def record_request(self, rows: int) -> None:
+        with self._lock:
+            self.requests += 1
+            self.rows += int(rows)
+
+    def record_queue_wait(self, seconds: float) -> None:
+        self.tel.add_phase_time("serve_queue", seconds)
+
+    def record_batch(self, bucket: int, rows: int) -> None:
+        with self._lock:
+            self.batches += 1
+            self.batched_rows += int(rows)
+            self.bucket_rows += int(bucket)
+            self.bucket_batches[int(bucket)] = \
+                self.bucket_batches.get(int(bucket), 0) + 1
+
+    def record_compile_cache(self, hit: bool) -> None:
+        with self._lock:
+            if hit:
+                self.cache_hits += 1
+            else:
+                self.cache_misses += 1
+
+    def serving_section(self, models: Optional[Dict[str, int]] = None,
+                        jit_entries: Optional[int] = None) -> Dict[str, Any]:
+        with self._lock:
+            elapsed = max(time.monotonic() - self._t0, 1e-9)
+            stage_ms = {}
+            for s in self.STAGES:
+                st = self.tel._phases.get(f"serve_{s}")
+                if st is not None:
+                    stage_ms[s] = {"total_ms": st[0] * 1e3, "count": st[1],
+                                   "max_ms": st[2] * 1e3}
+            return {
+                "requests": self.requests,
+                "rows": self.rows,
+                "batches": self.batches,
+                "qps": self.requests / elapsed,
+                "rows_per_s": self.rows / elapsed,
+                "batch_occupancy": (self.batched_rows / self.bucket_rows
+                                    if self.bucket_rows else 0.0),
+                "compile_cache": {"hits": self.cache_hits,
+                                  "misses": self.cache_misses,
+                                  "jit_entries": jit_entries},
+                "stage_ms": stage_ms,
+                "buckets": {str(b): c
+                            for b, c in sorted(self.bucket_batches.items())},
+                "models": dict(models or {}),
+            }
+
+    def report(self, models: Optional[Dict[str, int]] = None,
+               jit_entries: Optional[int] = None) -> Dict[str, Any]:
+        """Full telemetry report with the ``serving`` section attached —
+        validates against the extended ``observability/schema.json``."""
+        rep = self.tel.report()
+        rep["serving"] = self.serving_section(models, jit_entries)
+        return rep
+
+
+class _Request:
+    __slots__ = ("X", "n", "done", "result", "error", "t_enq")
+
+    def __init__(self, X: np.ndarray):
+        self.X = X
+        self.n = X.shape[0]
+        self.done = threading.Event()
+        self.result = None
+        self.error: Optional[BaseException] = None
+        self.t_enq = time.monotonic()
+
+
+class MicroBatcher:
+    """Coalesces concurrent requests into padded power-of-two batches.
+
+    ``predict_fn(Xpad, m)`` receives an ``(bucket, num_features)`` float64
+    matrix whose first ``m`` rows are real and returns host scores for
+    those rows (``(m,)`` or ``(m, K)``).  It runs ONLY on the worker
+    thread, so the device is never entered concurrently.
+    """
+
+    def __init__(self, predict_fn: Callable[[np.ndarray, int], np.ndarray],
+                 num_features: int, max_batch_rows: int = 1024,
+                 deadline_ms: float = 2.0, min_bucket: int = 16,
+                 stats: Optional[ServingStats] = None):
+        self.predict_fn = predict_fn
+        self.num_features = int(num_features)
+        self.max_rows = next_pow2(max_batch_rows)
+        self.min_bucket = min(next_pow2(min_bucket), self.max_rows)
+        self.deadline_s = float(deadline_ms) / 1e3
+        self.stats = stats or ServingStats()
+        self._q: "queue.Queue[_Request]" = queue.Queue()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "MicroBatcher":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._worker, name="lgbt-serve-batcher", daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    # -- request side (any thread) ------------------------------------------
+
+    def submit(self, X: np.ndarray, timeout: Optional[float] = None
+               ) -> np.ndarray:
+        """Blocking predict; rows of oversized requests are chunked to the
+        batch budget and re-concatenated."""
+        X = np.ascontiguousarray(np.atleast_2d(np.asarray(X, np.float64)))
+        if X.shape[1] != self.num_features:
+            raise ValueError(f"request has {X.shape[1]} features, model "
+                             f"expects {self.num_features}")
+        if X.shape[0] > self.max_rows:
+            parts = [self.submit(X[i:i + self.max_rows], timeout)
+                     for i in range(0, X.shape[0], self.max_rows)]
+            return np.concatenate(parts, axis=0)
+        self.stats.record_request(X.shape[0])
+        req = _Request(X)
+        self._q.put(req)
+        if not req.done.wait(timeout):
+            raise TimeoutError("prediction request timed out in the "
+                               "serving queue")
+        if req.error is not None:
+            raise req.error
+        return req.result
+
+    # -- worker side ---------------------------------------------------------
+
+    def _worker(self) -> None:
+        while not self._stop.is_set():
+            try:
+                first = self._q.get(timeout=0.05)
+            except queue.Empty:
+                continue
+            batch = [first]
+            rows = first.n
+            deadline = time.monotonic() + self.deadline_s
+            while rows < self.max_rows:
+                rem = deadline - time.monotonic()
+                if rem <= 0:
+                    break
+                try:
+                    r = self._q.get(timeout=rem)
+                except queue.Empty:
+                    break
+                batch.append(r)
+                rows += r.n
+            # preserve request boundaries while keeping every dispatch
+            # inside the row budget
+            group: List[_Request] = []
+            grows = 0
+            for r in batch:
+                if group and grows + r.n > self.max_rows:
+                    self._run_batch(group)
+                    group, grows = [], 0
+                group.append(r)
+                grows += r.n
+            if group:
+                self._run_batch(group)
+
+    def _run_batch(self, reqs: List[_Request]) -> None:
+        t_start = time.monotonic()
+        for r in reqs:
+            self.stats.record_queue_wait(t_start - r.t_enq)
+        m = sum(r.n for r in reqs)
+        bucket = max(self.min_bucket, next_pow2(m))
+        try:
+            with self.stats.stage("pad"):
+                Xpad = np.zeros((bucket, self.num_features), np.float64)
+                ofs = 0
+                for r in reqs:
+                    Xpad[ofs:ofs + r.n] = r.X
+                    ofs += r.n
+            scores = self.predict_fn(Xpad, m)
+            ofs = 0
+            for r in reqs:
+                r.result = scores[ofs:ofs + r.n]
+                ofs += r.n
+                r.done.set()
+            self.stats.record_batch(bucket, m)
+        except BaseException as e:
+            for r in reqs:
+                r.error = e
+                r.done.set()

@@ -1,0 +1,204 @@
+"""Device-side predict binning over padded per-feature arrays.
+
+``BinMapper.values_to_bins_predict`` (`lightgbm_tpu/binning.py`) is exact but
+per-feature: a Python loop building a fresh LUT per call, host-only.  This
+module re-expresses the whole mapper fleet as a handful of padded arrays so
+one vectorized pass bins every feature of a request matrix at once:
+
+  * ``bounds``   (F, B) float64 — each row is the feature's searchable upper
+    bounds (``bin_upper_bound[:r]`` — the exact slice ``values_to_bins``
+    searches), padded with ``+inf``.  ``searchsorted(side="left")`` returns
+    the count of bounds ``< v``, and ``+inf`` padding never counts, so the
+    padded search is bit-identical to the per-feature truncated search.
+  * ``cat_lut``  (F, C) int32 — category value → bin, padded/filled with the
+    OOV sentinel; ``cat_max`` carries each feature's ``lut_max`` so the
+    clip-and-mask replicates the mapper's unseen/negative handling.
+  * ``missing`` / ``nan_bin`` / ``default_bin`` / ``is_cat`` — per-feature
+    metadata driving the NaN rules.
+
+Two consumers share the arrays: ``bin_host`` (vectorized numpy, the
+``DevicePredictor.predict_raw`` fallback — golden-parity-tested against the
+old loop) and ``bin_device`` (jitted, the serving path — bins land on device
+already laid out as the ``(F_pad, N)`` matrix the packed traversal reads).
+Jit is keyed on array SHAPES, so serving's power-of-two row buckets each
+compile exactly once.
+
+Semantics (`tree.h:250-268` raw-prediction traversal): unseen or negative
+categories map to ``OOV_BIN`` — beyond every split bitset, always-right;
+NaN maps to the NaN bin (numerical, missing_type NaN), to ``OOV_BIN``
+(categorical, missing_type NaN), or probes as 0.0 otherwise.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..binning import BIN_CATEGORICAL, MISSING_NAN, BinMapper
+
+# categories unseen at train time probe past every split bitset → right
+# child, matching raw-value traversal (`tree.h:250-268`)
+OOV_BIN = 1 << 20
+
+# row-chunk budget for the host broadcast-count (bool bytes per chunk)
+_HOST_CHUNK_BYTES = 16 << 20
+
+
+class BinnerArrays:
+    """Padded per-feature binning arrays for one mapper fleet (see module
+    docstring).  Numpy-resident; device mirrors are created lazily and
+    cached so repeated jit calls see identical buffers."""
+
+    def __init__(self, bin_mappers: Sequence[BinMapper],
+                 used_feature_map, f_pad: int):
+        fu = len(bin_mappers)
+        self.used_feature_map = np.asarray(used_feature_map, dtype=np.int64)
+        self.f_pad = int(f_pad)
+        self.num_used = fu
+
+        r_list: List[int] = []
+        cat_sz: List[int] = []
+        for m in bin_mappers:
+            if m.bin_type == BIN_CATEGORICAL:
+                r_list.append(0)
+                # mapper LUT size: lut_max + 2 (`values_to_bins_predict`)
+                lut_max = max(m.categorical_2_bin.keys(), default=0)
+                cat_sz.append(lut_max + 2)
+            else:
+                r = m.num_bin - 1
+                if m.missing_type == MISSING_NAN:
+                    r -= 1
+                r_list.append(max(r, 0))
+                cat_sz.append(0)
+        B = max(max(r_list, default=0), 1)
+        C = max(max(cat_sz, default=0), 1)
+
+        self.bounds = np.full((max(fu, 1), B), np.inf, dtype=np.float64)
+        self.missing = np.zeros(max(fu, 1), dtype=np.int32)
+        self.nan_bin = np.zeros(max(fu, 1), dtype=np.int32)
+        self.default_bin = np.zeros(max(fu, 1), dtype=np.int32)
+        self.is_cat = np.zeros(max(fu, 1), dtype=bool)
+        self.cat_lut = np.full((max(fu, 1), C), OOV_BIN, dtype=np.int32)
+        self.cat_max = np.zeros(max(fu, 1), dtype=np.int32)
+        for k, m in enumerate(bin_mappers):
+            self.missing[k] = m.missing_type
+            self.nan_bin[k] = m.num_bin - 1
+            self.default_bin[k] = m.default_bin
+            if m.bin_type == BIN_CATEGORICAL:
+                self.is_cat[k] = True
+                lut_max = max(m.categorical_2_bin.keys(), default=0)
+                self.cat_max[k] = lut_max
+                for cat, b in m.categorical_2_bin.items():
+                    if cat >= 0:
+                        self.cat_lut[k, cat] = b
+            else:
+                r = r_list[k]
+                self.bounds[k, :r] = m.bin_upper_bound[:r]
+        self._dev = None
+
+    @classmethod
+    def for_data(cls, data) -> "BinnerArrays":
+        """Arrays for a dataset-like object (``_ConstructedDataset`` or
+        ``PredictionBinSchema``), cached on the object."""
+        arrs = getattr(data, "_binner_arrays", None)
+        if arrs is None:
+            arrs = cls(data.bin_mappers, data.used_feature_map,
+                       data.bins.shape[0])
+            data._binner_arrays = arrs
+        return arrs
+
+    # -- host variant (vectorized numpy; parity-pinned) ----------------------
+
+    def bin_host(self, X: np.ndarray) -> np.ndarray:
+        """(f_pad, n) int32 predict-bins of an (n, num_total_features) raw
+        matrix — bit-identical to calling ``values_to_bins_predict`` per
+        used feature (`tests/test_serving.py` golden parity)."""
+        X = np.asarray(X, dtype=np.float64)
+        n = X.shape[0]
+        fu = self.num_used
+        out = np.zeros((self.f_pad, n), dtype=np.int32)
+        if fu == 0 or n == 0:
+            return out
+        v = np.ascontiguousarray(X[:, self.used_feature_map].T)  # (fu, n)
+        nan = np.isnan(v)
+        v0 = np.where(nan, 0.0, v)
+
+        # numerical: count bounds < v (== searchsorted side="left") in row
+        # chunks bounded by _HOST_CHUNK_BYTES of comparison intermediates
+        B = self.bounds.shape[1]
+        cnt = np.empty((fu, n), dtype=np.int32)
+        chunk = max(128, _HOST_CHUNK_BYTES // max(fu * B, 1))
+        for c0 in range(0, n, chunk):
+            c1 = min(c0 + chunk, n)
+            cnt[:, c0:c1] = (
+                self.bounds[:, :, None] < v0[:, None, c0:c1]
+            ).sum(axis=1, dtype=np.int32)
+        num = np.where(nan & (self.missing[:, None] == MISSING_NAN),
+                       self.nan_bin[:, None], cnt)
+
+        # categorical: LUT probe with the mapper's exact clip-and-mask
+        iv = v0.astype(np.int64)
+        cm = self.cat_max[:, None].astype(np.int64)
+        oov_mask = (iv < 0) | (iv > cm)
+        gathered = np.take_along_axis(
+            self.cat_lut, np.clip(iv, 0, cm).astype(np.int64), axis=1)
+        cat = np.where(oov_mask, OOV_BIN, gathered)
+        # raw categorical prediction always sends NaN right under
+        # missing_type NaN (`tree.h:255-258`)
+        cat = np.where(nan & (self.missing[:, None] == MISSING_NAN),
+                       OOV_BIN, cat)
+
+        out[:fu] = np.where(self.is_cat[:, None], cat, num)
+        return out
+
+    # -- device variant (jitted; serving + bucketed buckets) -----------------
+
+    def device_arrays(self):
+        if self._dev is None:
+            self._dev = (jnp.asarray(self.bounds), jnp.asarray(self.missing),
+                         jnp.asarray(self.nan_bin), jnp.asarray(self.is_cat),
+                         jnp.asarray(self.cat_lut), jnp.asarray(self.cat_max))
+        return self._dev
+
+    def bin_device(self, Xu):
+        """(f_pad, N) int32 device bins of an (N, num_used) device/host
+        matrix of USED-feature columns (caller selects ``used_feature_map``
+        columns; rows may be padding).  Jit-cached per (N, fu) shape."""
+        bounds, missing, nan_bin, is_cat, cat_lut, cat_max = \
+            self.device_arrays()
+        return _bin_device(Xu, bounds, missing, nan_bin, is_cat, cat_lut,
+                           cat_max, f_pad=self.f_pad)
+
+    def select_used(self, X: np.ndarray) -> np.ndarray:
+        """Host helper: (n, num_total_features) → contiguous (n, num_used)
+        float matrix of the used columns (the ``bin_device`` input)."""
+        X = np.asarray(X, dtype=np.float64)
+        return np.ascontiguousarray(X[:, self.used_feature_map])
+
+
+@functools.partial(jax.jit, static_argnames=("f_pad",))
+def _bin_device(xu, bounds, missing, nan_bin, is_cat, cat_lut, cat_max, *,
+                f_pad: int):
+    v = xu.T.astype(bounds.dtype)                       # (fu, n)
+    nan = jnp.isnan(v)
+    v0 = jnp.where(nan, 0.0, v)
+    nan_missing = nan & (missing[:, None] == MISSING_NAN)
+
+    # numerical: per-feature binary search over the +inf-padded bounds rows
+    cnt = jax.vmap(lambda b, col: jnp.searchsorted(b, col, side="left"))(
+        bounds, v0).astype(jnp.int32)
+    num = jnp.where(nan_missing, nan_bin[:, None], cnt)
+
+    # categorical: LUT probe; unseen/negative/NaN(missing-NaN) → OOV
+    iv = v0.astype(jnp.int32)
+    cm = cat_max[:, None]
+    oov_mask = (iv < 0) | (iv > cm)
+    gathered = jnp.take_along_axis(cat_lut, jnp.clip(iv, 0, cm), axis=1)
+    cat = jnp.where(oov_mask | nan_missing, OOV_BIN, gathered)
+
+    bins = jnp.where(is_cat[:, None], cat, num)
+    return jnp.pad(bins, ((0, f_pad - bins.shape[0]), (0, 0)))

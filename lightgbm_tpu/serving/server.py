@@ -1,0 +1,246 @@
+"""Threaded prediction server + client over length-prefixed pickle frames.
+
+The serving analogue of the construction-phase ``SocketNet``
+(`io/net.py`): same framing (8-byte LE length + pickle via
+``send_frame``/``recv_frame``), but a request/response RPC instead of a
+collective relay.  One accept loop, one handler thread per connection; all
+predictions funnel through per-model ``MicroBatcher`` workers so concurrent
+clients coalesce into shared device batches.
+
+Ops (dict in, dict out; ``{"ok": False, "error": ...}`` on failure):
+
+  * ``predict``  — ``{"op", "model", "data": ndarray, "raw_score"}`` →
+    ``{"ok": True, "scores": ndarray}``
+  * ``swap``     — ``{"op", "model", "model_str"}`` → load/verify/hot-swap
+    a new model text; the old version serves until the swap commits
+  * ``stats``    — full telemetry report (``serving`` schema section)
+  * ``ping`` / ``shutdown``
+
+Start via ``Booster.serve()`` or ``python -m lightgbm_tpu serve
+input_model=model.txt``.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ..io.net import recv_frame, send_frame
+from .batcher import MicroBatcher, ServingStats, bucket_ladder
+from .registry import ModelRegistry
+
+
+class PredictionServer:
+    """Long-lived serving process state: registry + batchers + listener."""
+
+    def __init__(self, booster=None, registry: Optional[ModelRegistry] = None,
+                 host: str = "127.0.0.1", port: int = 0,
+                 max_batch_rows: int = 256, deadline_ms: float = 2.0,
+                 min_bucket: int = 32, warmup: bool = True,
+                 telemetry_out: str = "", request_timeout: float = 60.0):
+        self.host = host
+        self.port = int(port)
+        self.max_batch_rows = int(max_batch_rows)
+        self.deadline_ms = float(deadline_ms)
+        self.min_bucket = int(min_bucket)
+        self.telemetry_out = telemetry_out
+        self.request_timeout = float(request_timeout)
+        self.stats = ServingStats()
+        self.buckets = bucket_ladder(min_bucket, max_batch_rows)
+        self.registry = registry or ModelRegistry(
+            stats=self.stats, warm_buckets=self.buckets, warmup=warmup)
+        if registry is not None and not registry.warm_buckets:
+            registry.warm_buckets = self.buckets
+        self.registry.stats = self.stats
+        if booster is not None:
+            self.registry.load("default", booster=booster)
+        self._batchers: Dict[str, MicroBatcher] = {}
+        self._batcher_lock = threading.Lock()
+        self._srv: Optional[socket.socket] = None
+        self._accept_thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._stopped = threading.Event()
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "PredictionServer":
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind((self.host, self.port))
+        srv.listen(16)
+        srv.settimeout(0.25)          # poll the stop flag
+        self.port = srv.getsockname()[1]
+        self._srv = srv
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="lgbt-serve-accept", daemon=True)
+        self._accept_thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._stop.is_set():
+            return
+        self._stop.set()
+        if self._srv is not None:
+            try:
+                self._srv.close()
+            except OSError:
+                pass
+        with self._batcher_lock:
+            batchers = list(self._batchers.values())
+        for b in batchers:
+            b.stop()
+        if self.telemetry_out:
+            from ..observability import write_report
+            write_report(self.report(), self.telemetry_out)
+        self._stopped.set()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._stopped.wait(timeout)
+
+    def __enter__(self) -> "PredictionServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- report --------------------------------------------------------------
+
+    def report(self) -> Dict[str, Any]:
+        return self.stats.report(models=self.registry.versions(),
+                                 jit_entries=self.registry.jit_entries())
+
+    # -- batching ------------------------------------------------------------
+
+    def _batcher(self, name: str) -> MicroBatcher:
+        with self._batcher_lock:
+            b = self._batchers.get(name)
+            if b is None:
+                # resolve the model at BATCH time so a hot-swap is picked
+                # up atomically at the next batch boundary
+                def predict_fn(Xpad, m, _name=name):
+                    return self.registry.get(_name).predict_padded(Xpad, m)
+
+                b = MicroBatcher(
+                    predict_fn,
+                    num_features=self.registry.get(name).num_features,
+                    max_batch_rows=self.max_batch_rows,
+                    deadline_ms=self.deadline_ms,
+                    min_bucket=self.min_bucket, stats=self.stats).start()
+                self._batchers[name] = b
+            return b
+
+    # -- connection handling -------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _addr = self._srv.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            threading.Thread(target=self._handle, args=(conn,),
+                             name="lgbt-serve-conn", daemon=True).start()
+
+    def _handle(self, conn: socket.socket) -> None:
+        conn.settimeout(self.request_timeout + 30.0)
+        try:
+            while not self._stop.is_set():
+                try:
+                    msg = recv_frame(conn)
+                except (ConnectionError, socket.timeout, OSError, EOFError):
+                    break
+                try:
+                    resp = self._dispatch(msg)
+                except BaseException as e:
+                    resp = {"ok": False, "error": f"{type(e).__name__}: {e}"}
+                try:
+                    send_frame(conn, resp)
+                except OSError:
+                    break
+                if isinstance(msg, dict) and msg.get("op") == "shutdown":
+                    break
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _dispatch(self, msg) -> Dict[str, Any]:
+        if not isinstance(msg, dict) or "op" not in msg:
+            return {"ok": False, "error": "malformed request"}
+        op = msg["op"]
+        if op == "ping":
+            return {"ok": True}
+        if op == "predict":
+            name = msg.get("model", "default")
+            model = self.registry.get(name)
+            X = np.atleast_2d(np.asarray(msg["data"], dtype=np.float64))
+            raw = self._batcher(name).submit(X, timeout=self.request_timeout)
+            scores = model.convert_output(raw, bool(msg.get("raw_score")))
+            return {"ok": True, "scores": np.asarray(scores)}
+        if op == "swap":
+            version = self.registry.load(
+                msg.get("model", "default"), model_str=msg.get("model_str"),
+                model_file=msg.get("model_file"))
+            return {"ok": True, "version": version}
+        if op == "stats":
+            return {"ok": True, "report": self.report()}
+        if op == "shutdown":
+            # ack first; stop from a side thread (stop() joins batcher
+            # threads and must not run on this handler)
+            threading.Thread(target=self.stop, daemon=True).start()
+            return {"ok": True}
+        return {"ok": False, "error": f"unknown op {op!r}"}
+
+
+class ServingClient:
+    """Tiny blocking client for ``PredictionServer`` (same framing)."""
+
+    def __init__(self, host: str, port: int, timeout: float = 60.0):
+        self._sock = socket.create_connection((host, port), timeout=timeout)
+        self._sock.settimeout(timeout)
+        self._lock = threading.Lock()
+
+    def _call(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+        with self._lock:
+            send_frame(self._sock, msg)
+            resp = recv_frame(self._sock)
+        if not resp.get("ok"):
+            raise RuntimeError(f"server error: {resp.get('error')}")
+        return resp
+
+    def ping(self) -> bool:
+        return self._call({"op": "ping"})["ok"]
+
+    def predict(self, X, model: str = "default",
+                raw_score: bool = False) -> np.ndarray:
+        resp = self._call({"op": "predict", "model": model,
+                           "data": np.asarray(X, dtype=np.float64),
+                           "raw_score": raw_score})
+        return resp["scores"]
+
+    def swap(self, model_str: str, model: str = "default") -> int:
+        return self._call({"op": "swap", "model": model,
+                           "model_str": model_str})["version"]
+
+    def stats(self) -> Dict[str, Any]:
+        return self._call({"op": "stats"})["report"]
+
+    def shutdown(self) -> None:
+        self._call({"op": "shutdown"})
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def __enter__(self) -> "ServingClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

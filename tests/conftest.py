@@ -40,3 +40,33 @@ import pytest  # noqa: E402
 @pytest.fixture
 def rng():
     return np.random.RandomState(42)
+
+
+# per-test timeout for serving-marked tests (threads + sockets): a hung
+# accept loop or a lost batcher event must fail ONE test, not stall the
+# tier-1 suite.  SIGALRM fires in the main thread, which is exactly where
+# the test body blocks; no external pytest-timeout dependency needed.
+import signal  # noqa: E402
+
+_SERVING_TIMEOUT_S = 120
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_call(item):
+    marker = item.get_closest_marker("serving")
+    if marker is None or not hasattr(signal, "SIGALRM"):
+        yield
+        return
+    timeout = int(marker.kwargs.get("timeout", _SERVING_TIMEOUT_S))
+
+    def _on_alarm(signum, frame):
+        raise TimeoutError(
+            f"serving test exceeded its {timeout}s SIGALRM timeout")
+
+    old = signal.signal(signal.SIGALRM, _on_alarm)
+    signal.alarm(timeout)
+    try:
+        yield
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old)

@@ -1,0 +1,373 @@
+"""Serving subsystem: binner parity, micro-batcher, hot-swap registry,
+socket round-trip, zero-recompile buckets, CLI serve end-to-end."""
+
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.observability import validate_report
+from lightgbm_tpu.serving import OOV_BIN, BinnerArrays, MicroBatcher, \
+    ModelRegistry, PredictionServer, ServingClient, ServingStats
+
+
+def _train_matrix(rng, n=2500):
+    X = np.column_stack([
+        rng.randn(n),
+        rng.randint(0, 12, n).astype(float),          # categorical
+        rng.randn(n) * 10,
+        np.where(rng.rand(n) < 0.4, 0.0, rng.randn(n)),
+    ])
+    X[::13, 0] = np.nan
+    X[::7, 1] = np.nan
+    y = (np.nan_to_num(X[:, 0]) + (X[:, 1] % 3 == 1) > 0.5).astype(float)
+    return X, y
+
+
+def _fuzz_matrix(rng, n=700):
+    X = np.column_stack([
+        rng.randn(n),
+        rng.randint(-3, 25, n).astype(float),         # unseen + negative cats
+        rng.randn(n) * 10,
+        np.where(rng.rand(n) < 0.4, 0.0, rng.randn(n)),
+    ])
+    X[::11, 0] = np.nan
+    X[::5, 1] = np.nan
+    X[3 % n, 1] = 7.9                                 # fractional category
+    return X
+
+
+def _train(rng, trees=12, **params):
+    X, y = _train_matrix(rng)
+    p = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+         "min_data_in_leaf": 10}
+    p.update(params)
+    return lgb.train(p, lgb.Dataset(X, label=y, categorical_feature=[1]),
+                     trees)
+
+
+def _host_raw(gbdt, X):
+    X = np.ascontiguousarray(X, dtype=np.float64)
+    k = max(gbdt.num_tree_per_iteration, 1)
+    out = np.zeros((X.shape[0], k))
+    for i, t in enumerate(gbdt.models):
+        out[:, i % k] += t.predict(X)
+    return out[:, 0] if k == 1 else out
+
+
+# -- binner ------------------------------------------------------------------
+
+def test_binner_host_golden_parity(rng):
+    """Vectorized host binner is bit-identical to the per-feature
+    ``values_to_bins_predict`` loop it replaced (NaN, zero-bin,
+    categorical OOV/negative/fractional)."""
+    bst = _train(rng)
+    con = bst.gbdt.train_data
+    arrs = BinnerArrays.for_data(con)
+    Xt = _fuzz_matrix(rng)
+    golden = np.zeros((con.bins.shape[0], len(Xt)), np.int32)
+    for k in range(con.num_used_features):
+        j = int(con.used_feature_map[k])
+        golden[k] = con.bin_mappers[k].values_to_bins_predict(
+            Xt[:, j], OOV_BIN)
+    np.testing.assert_array_equal(arrs.bin_host(Xt), golden)
+
+
+def test_binner_device_matches_host(rng):
+    import jax.numpy as jnp
+
+    bst = _train(rng)
+    arrs = bst.gbdt.train_data.binner_arrays()
+    Xt = _fuzz_matrix(rng)
+    host = arrs.bin_host(Xt)
+    dev = np.asarray(arrs.bin_device(jnp.asarray(arrs.select_used(Xt))))
+    np.testing.assert_array_equal(dev, host)
+
+
+def test_predict_raw_uses_vectorized_binner(rng):
+    """DevicePredictor.predict_raw (now binner-backed) still matches the
+    host per-tree traversal."""
+    from lightgbm_tpu.predictor import DevicePredictor
+
+    bst = _train(rng, trees=20)
+    Xt = _fuzz_matrix(rng)
+    dp = DevicePredictor(bst.gbdt, bst.gbdt.train_data)
+    np.testing.assert_allclose(dp.predict_raw(Xt), _host_raw(bst.gbdt, Xt),
+                               rtol=1e-6, atol=1e-6)
+
+
+# -- loaded models serve on device ------------------------------------------
+
+def test_loaded_model_serves_on_device(rng):
+    """A booster loaded from model text reconstructs a bin schema from the
+    text (thresholds → bounds, feature_infos → cat vocab) and traverses on
+    device, matching the host traversal exactly."""
+    bst = _train(rng, trees=30)
+    loaded = lgb.Booster(model_str=bst.model_to_string())
+    assert loaded.gbdt.train_data is None
+    Xt = np.vstack([_fuzz_matrix(rng) for _ in range(10)])  # 7000 rows
+    raw = loaded.predict(Xt, raw_score=True)   # n*trees > 200k → device
+    schema = loaded.gbdt._pred_schema
+    assert schema is not None and schema[0] is not None, \
+        "device bin schema was not reconstructed"
+    np.testing.assert_allclose(raw, _host_raw(loaded.gbdt, Xt),
+                               rtol=1e-9, atol=1e-9)
+
+
+# -- atomic model writes ------------------------------------------------------
+
+def test_save_model_atomic(tmp_path, rng):
+    bst = _train(rng, trees=3)
+    path = tmp_path / "model.txt"
+    bst.save_model(str(path))
+    good = path.read_text()
+    assert good.startswith("gbdt")
+    # no tempfiles left behind
+    assert [p.name for p in tmp_path.iterdir()] == ["model.txt"]
+
+    # a failure mid-write must leave the existing model untouched
+    real_replace = os.replace
+
+    def boom(src, dst):
+        raise OSError("simulated preemption")
+
+    os.replace = boom
+    try:
+        with pytest.raises(OSError):
+            bst.save_model(str(path))
+    finally:
+        os.replace = real_replace
+    assert path.read_text() == good
+    assert [p.name for p in tmp_path.iterdir()] == ["model.txt"]
+
+
+# -- micro-batcher ------------------------------------------------------------
+
+@pytest.mark.serving
+def test_batcher_coalesces_concurrent_requests(rng):
+    stats = ServingStats()
+    calls = []
+
+    def predict_fn(Xpad, m):
+        calls.append((Xpad.shape[0], m))
+        return Xpad[:m, 0] * 2.0
+
+    b = MicroBatcher(predict_fn, num_features=3, max_batch_rows=128,
+                     deadline_ms=120.0, min_bucket=16, stats=stats).start()
+    try:
+        Xs = [rng.randn(5, 3), rng.randn(7, 3), rng.randn(4, 3)]
+        out = [None] * 3
+        threads = [threading.Thread(
+            target=lambda i=i: out.__setitem__(i, b.submit(Xs[i], timeout=30)))
+            for i in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(30)
+        for i in range(3):
+            np.testing.assert_allclose(out[i], Xs[i][:, 0] * 2.0)
+        # all three coalesced into one padded power-of-two batch
+        assert len(calls) == 1
+        assert calls[0] == (16, 16) and stats.batches == 1
+        assert stats.requests == 3 and stats.rows == 16
+    finally:
+        b.stop()
+
+
+@pytest.mark.serving
+def test_batcher_deadline_and_oversize_chunking(rng):
+    stats = ServingStats()
+    calls = []
+
+    def predict_fn(Xpad, m):
+        calls.append(Xpad.shape[0])
+        return Xpad[:m, 0]
+
+    b = MicroBatcher(predict_fn, num_features=2, max_batch_rows=64,
+                     deadline_ms=5.0, min_bucket=8, stats=stats).start()
+    try:
+        t0 = time.monotonic()
+        b.submit(rng.randn(3, 2), timeout=30)
+        assert time.monotonic() - t0 < 5.0, "deadline did not bound latency"
+        assert calls == [8]
+        # oversized request chunks to the row budget
+        out = b.submit(rng.randn(150, 2), timeout=30)
+        assert out.shape == (150,)
+        assert calls[1:] == [64, 64, 32]
+        # feature-count mismatch is rejected before it reaches the device
+        with pytest.raises(ValueError):
+            b.submit(rng.randn(4, 5), timeout=5)
+    finally:
+        b.stop()
+
+
+# -- registry -----------------------------------------------------------------
+
+@pytest.mark.serving
+def test_registry_hot_swap_and_rollback(rng):
+    reg = ModelRegistry(warm_buckets=[32, 64], verify_rows=48)
+    bst1 = _train(rng, trees=6)
+    assert reg.load("default", booster=bst1) == 1
+    m1 = reg.get("default")
+    X = _fuzz_matrix(rng, 20)
+    Xpad = np.zeros((32, 4))
+    Xpad[:20] = X
+    s1 = m1.predict_padded(Xpad, 20)
+    np.testing.assert_allclose(s1, _host_raw(bst1.gbdt, X),
+                               rtol=1e-6, atol=1e-6)
+
+    # hot-swap from model TEXT (no training data — reconstructed schema)
+    bst2 = _train(rng, trees=3, num_leaves=7)
+    assert reg.load("default", model_str=bst2.model_to_string()) == 2
+    m2 = reg.get("default")
+    assert m2.version == 2 and m2 is not m1
+    np.testing.assert_allclose(m2.predict_padded(Xpad, 20),
+                               _host_raw(bst2.gbdt, X),
+                               rtol=1e-6, atol=1e-6)
+
+    # a corrupt model text must not dislodge the serving version
+    with pytest.raises(Exception):
+        reg.load("default", model_str="not a model")
+    assert reg.get("default") is m2
+    assert reg.versions() == {"default": 2}
+
+
+# -- server round trip --------------------------------------------------------
+
+@pytest.mark.serving
+def test_server_round_trip_and_schema(rng):
+    bst = _train(rng, trees=10)
+    server = bst.serve(port=0, max_batch_rows=128, min_bucket=32,
+                       deadline_ms=2.0)
+    try:
+        with ServingClient("127.0.0.1", server.port, timeout=60) as c:
+            assert c.ping()
+            for n in (3, 17, 29):
+                Xt = _fuzz_matrix(rng, n)
+                np.testing.assert_allclose(
+                    np.asarray(c.predict(Xt)).ravel(), bst.predict(Xt),
+                    rtol=1e-6, atol=1e-6)
+                np.testing.assert_allclose(
+                    np.asarray(c.predict(Xt, raw_score=True)).ravel(),
+                    bst.predict(Xt, raw_score=True), rtol=1e-6, atol=1e-6)
+            rep = c.stats()
+    finally:
+        server.stop()
+    assert validate_report(rep) == []
+    srv = rep["serving"]
+    assert srv["requests"] >= 6 and srv["batches"] >= 6
+    assert srv["qps"] > 0 and 0 < srv["batch_occupancy"] <= 1
+    assert set(srv["stage_ms"]) >= {"queue", "bin", "traverse", "unpad"}
+    assert srv["models"] == {"default": 1}
+
+
+@pytest.mark.serving
+def test_zero_recompiles_within_bucket(rng):
+    """≥3 distinct request sizes inside one power-of-two bucket reuse ONE
+    jit entry: the underlying jit caches do not grow after warmup."""
+    from lightgbm_tpu.predictor import _predict_all
+    from lightgbm_tpu.serving.binner import _bin_device
+
+    bst = _train(rng, trees=8)
+    server = bst.serve(port=0, max_batch_rows=64, min_bucket=64,
+                       deadline_ms=1.0)   # single bucket: 64
+    try:
+        with ServingClient("127.0.0.1", server.port, timeout=60) as c:
+            c.predict(_fuzz_matrix(rng, 5))      # post-warmup settle
+            before = (_bin_device._cache_size(),
+                      _predict_all._cache_size())
+            for n in (9, 33, 64, 21):
+                c.predict(_fuzz_matrix(rng, n))
+            after = (_bin_device._cache_size(),
+                     _predict_all._cache_size())
+            rep = c.stats()
+    finally:
+        server.stop()
+    assert after == before, f"request path recompiled: {before} -> {after}"
+    srv = rep["serving"]
+    # every post-warmup batch was a compile-cache hit
+    assert srv["compile_cache"]["misses"] == 1      # the single warmed bucket
+    assert srv["compile_cache"]["hits"] >= 5
+    assert list(srv["buckets"]) == ["64"]
+
+
+@pytest.mark.serving
+def test_server_hot_swap_over_the_wire(rng):
+    bst1 = _train(rng, trees=8)
+    bst2 = _train(rng, trees=4, num_leaves=7, learning_rate=0.3)
+    server = bst1.serve(port=0, max_batch_rows=64, min_bucket=32,
+                        deadline_ms=1.0)
+    try:
+        with ServingClient("127.0.0.1", server.port, timeout=60) as c:
+            Xt = _fuzz_matrix(rng, 10)
+            np.testing.assert_allclose(np.asarray(c.predict(Xt)).ravel(),
+                                       bst1.predict(Xt), rtol=1e-6,
+                                       atol=1e-6)
+            assert c.swap(bst2.model_to_string()) == 2
+            np.testing.assert_allclose(np.asarray(c.predict(Xt)).ravel(),
+                                       bst2.predict(Xt), rtol=1e-6,
+                                       atol=1e-6)
+            with pytest.raises(RuntimeError):
+                c.swap("garbage")
+            assert c.stats()["serving"]["models"] == {"default": 2}
+    finally:
+        server.stop()
+
+
+# -- CLI end to end -----------------------------------------------------------
+
+@pytest.mark.serving(timeout=300)
+def test_cli_serve_end_to_end(tmp_path, rng):
+    """`python -m lightgbm_tpu serve` round trip: served scores equal
+    Booster.predict, zero recompiles across 3 sizes in one bucket, and the
+    telemetry report written on shutdown validates against the schema."""
+    import json
+
+    bst = _train(rng, trees=10)
+    model_path = tmp_path / "model.txt"
+    bst.save_model(str(model_path))
+    report_path = tmp_path / "serving_report.json"
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu", JAX_ENABLE_X64="1")
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.Popen(
+        [sys.executable, "-u", "-m", "lightgbm_tpu", "serve",
+         f"input_model={model_path}", "serve_port=0", "serve_min_bucket=64",
+         "serve_max_batch_rows=64", f"telemetry_out={report_path}"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env, cwd=str(tmp_path))
+    port = None
+    try:
+        deadline = time.monotonic() + 240
+        while time.monotonic() < deadline:
+            line = proc.stdout.readline()
+            if not line and proc.poll() is not None:
+                raise AssertionError("serve process exited early")
+            if "Serving" in line and " at " in line:
+                port = int(line.split(" at ")[1].split()[0].rsplit(":", 1)[1])
+                break
+        assert port, "serve process never reported its port"
+        with ServingClient("127.0.0.1", port, timeout=120) as c:
+            for n in (5, 23, 41):      # 3 sizes, all in the 64 bucket
+                Xt = _fuzz_matrix(rng, n)
+                got = np.asarray(c.predict(Xt)).ravel()
+                np.testing.assert_allclose(got, bst.predict(Xt),
+                                           rtol=1e-6, atol=1e-6)
+            rep = c.stats()
+            assert rep["serving"]["compile_cache"]["misses"] == 1
+            assert rep["serving"]["compile_cache"]["hits"] >= 4
+            c.shutdown()
+        proc.wait(timeout=60)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+    assert validate_report(rep) == []
+    on_disk = json.loads(report_path.read_text())
+    assert validate_report(on_disk) == []
+    assert on_disk["serving"]["requests"] >= 3
