@@ -753,8 +753,10 @@ class GBDT:
                 log(f"{time.time()-start:.6f} seconds elapsed, finished "
                     f"iteration {it + 1}")
             if snapshot_freq > 0 and (it + 1) % snapshot_freq == 0:
-                self.save_model_to_file(
-                    f"{model_output_path}.snapshot_iter_{it + 1}")
+                # atomic write + fingerprint sidecar + keep-last-K
+                # retention (cfg.snapshot_keep) in one call
+                from ..reliability.resume import save_snapshot
+                save_snapshot(self, model_output_path, it + 1, self.cfg)
 
     # -- eval / early stop (`gbdt.cpp:432-533`) ------------------------------
 

@@ -81,6 +81,15 @@ def run_train(params: Dict[str, str], cfg: Config) -> None:
     if cfg.telemetry_out and not cfg.telemetry:
         cfg.telemetry = True
         params = dict(params, telemetry="true")
+    if cfg.resume:
+        # engine.train re-runs the same deterministic detection; this is
+        # only the operator-facing log line
+        from .reliability.resume import find_resume_snapshot
+        found = find_resume_snapshot(cfg.output_model, cfg)
+        if found is not None:
+            _log(f"Resuming from snapshot {found[1]} (iteration {found[0]})")
+        else:
+            _log("--resume: no valid snapshot found, training from scratch")
     t0 = time.time()
     train_set = Dataset(cfg.data, params=dict(params))
     valid_sets = []
@@ -176,12 +185,16 @@ def run_serve(params: Dict[str, str], cfg: Config) -> None:
 
     if not cfg.input_model:
         raise ValueError("task=serve requires input_model")
+    if cfg.fault_spec:
+        from .reliability import faults
+        faults.arm(cfg.fault_spec)
     booster = Booster(model_file=cfg.input_model, params=dict(params))
     server = booster.serve(
         host=cfg.serve_host, port=cfg.serve_port,
         max_batch_rows=cfg.serve_max_batch_rows,
         deadline_ms=cfg.serve_deadline_ms,
         min_bucket=cfg.serve_min_bucket, warmup=cfg.serve_warmup,
+        max_inflight=cfg.serve_max_inflight,
         telemetry_out=cfg.telemetry_out)
     _log(f"Serving {cfg.input_model} at {server.host}:{server.port} "
          f"(buckets {server.buckets}, deadline {cfg.serve_deadline_ms} ms)")

@@ -247,6 +247,14 @@ class Config:
     data_random_seed: int = 1
     output_model: str = "LightGBM_model.txt"
     snapshot_freq: int = -1
+    # snapshot retention: keep only the newest K snapshot_iter_* files
+    # (0 or less = keep everything) — `reliability/resume.py`
+    snapshot_keep: int = 3
+    # crash-safe resume: auto-detect the newest VALID snapshot of
+    # output_model (model text complete + config fingerprint matching),
+    # continue-train from it, and train only the remaining iterations.
+    # CLI: `--resume`.  No valid snapshot = train from scratch.
+    resume: bool = False
     input_model: str = ""
     output_result: str = "LightGBM_predict_result.txt"
     initscore_filename: str = ""
@@ -303,6 +311,18 @@ class Config:
     time_out: int = 120
     machine_list_filename: str = ""
     machines: str = ""
+    # --- reliability (lightgbm_tpu/reliability/) ---
+    # hard cap on a single SocketNet/serving wire frame: a corrupt length
+    # prefix fails with a ConnectionError instead of a multi-GB allocation
+    net_max_frame_mb: int = 256
+    # per-collective deadline for the construction-phase SocketNet
+    # (seconds; 0 = use time_out).  A rank that cannot produce its payload
+    # in time fails the collective on EVERY rank with the late rank named
+    net_collective_deadline_s: float = 0.0
+    # deterministic fault-injection plan (reliability/faults.py grammar),
+    # e.g. "net.send.drop:rank=1;serve.predict.fail:count=-1".  Also
+    # armable via the LGBT_FAULTS environment variable.  Empty = off
+    fault_spec: str = ""
 
     # --- device (tpu-specific; gpu_* accepted for compat and ignored) ---
     gpu_platform_id: int = -1
@@ -398,6 +418,10 @@ class Config:
     # compile every bucket shape at startup so the request path never
     # recompiles; disable only for debugging
     serve_warmup: bool = True
+    # bounded admission: at most this many predict requests between
+    # admission and response; the rest shed with a structured
+    # {"error": "overloaded"} frame (reliability/degrade.py)
+    serve_max_inflight: int = 64
     # replay stall correction batch: when the exact greedy replay reaches
     # a leaf the speculative growth never split, split up to this many of
     # the highest-priority unsplit frontier leaves in ONE correction pass

@@ -238,7 +238,7 @@ class Booster:
         """An UNSTARTED ``PredictionServer`` with this booster registered
         as the ``default`` model (see README "Serving").  Keyword args are
         forwarded (host/port/max_batch_rows/deadline_ms/min_bucket/
-        warmup/telemetry_out)."""
+        warmup/max_inflight/telemetry_out)."""
         from .serving import PredictionServer
 
         return PredictionServer(booster=self, **kwargs)
@@ -279,8 +279,16 @@ def train(params: Dict, train_set: Dataset, num_boost_round: int = 100,
           early_stopping_rounds: Optional[int] = None,
           evals_result: Optional[Dict] = None, verbose_eval=True,
           learning_rates=None, keep_training_booster: bool = False,
-          callbacks: Optional[List[Callable]] = None) -> Booster:
-    """`python-package/lightgbm/engine.py:19-245` semantics."""
+          callbacks: Optional[List[Callable]] = None,
+          resume: Optional[bool] = None) -> Booster:
+    """`python-package/lightgbm/engine.py:19-245` semantics.
+
+    Beyond the reference: ``snapshot_freq > 0`` checkpoints the model text
+    every K iterations (atomic write + config-fingerprint sidecar +
+    retention — `reliability/resume.py`), and ``resume=True`` (or config
+    ``resume``/CLI ``--resume``) continues a killed run from the newest
+    valid snapshot, training only the REMAINING iterations so the result
+    is identical to an uninterrupted run."""
     params = dict(params or {})
     cfg_probe = Config.from_params(params)
     if "num_iterations" not in params and num_boost_round is not None:
@@ -288,6 +296,21 @@ def train(params: Dict, train_set: Dataset, num_boost_round: int = 100,
     num_boost_round = Config.from_params(params).num_iterations
     if fobj is not None:
         params["objective"] = "none"
+    if cfg_probe.fault_spec:
+        from .reliability import faults
+        faults.arm(cfg_probe.fault_spec)
+
+    # crash-safe resume: newest valid snapshot becomes the init model and
+    # num_boost_round stays the TOTAL target, not an increment
+    resumed_iter: Optional[int] = None
+    if (resume if resume is not None else cfg_probe.resume) \
+            and init_model is None:
+        from .reliability.metrics import rel_inc
+        from .reliability.resume import find_resume_snapshot
+        found = find_resume_snapshot(cfg_probe.output_model, cfg_probe)
+        if found is not None:
+            resumed_iter, init_model = found
+            rel_inc("resume_runs")
 
     train_set.params = {**params, **(train_set.params or {})}
     if feature_name != "auto":
@@ -300,6 +323,16 @@ def train(params: Dict, train_set: Dataset, num_boost_round: int = 100,
         init_booster = init_model if isinstance(init_model, Booster) else \
             Booster(model_file=init_model, params=params)
         _continue_training(booster, init_booster)
+        if resumed_iter is not None and isinstance(init_model, str):
+            # exact continuation: the state sidecar restores the LIVE
+            # float32 score array and RNG streams, making the resumed
+            # run bit-identical to an uninterrupted one (the traversal
+            # replay above is a ulp-level approximation of it)
+            from .reliability.resume import (load_snapshot_state,
+                                             restore_training_state)
+            state = load_snapshot_state(init_model)
+            if state is not None:
+                restore_training_state(booster.gbdt, state)
 
     valid_sets = list(valid_sets or [])
     names = []
@@ -332,6 +365,11 @@ def train(params: Dict, train_set: Dataset, num_boost_round: int = 100,
     callbacks_after.sort(key=lambda cb: getattr(cb, "order", 0))
 
     init_iter = booster.current_iteration
+    # resumed runs train to the configured TOTAL; init_model continuation
+    # keeps the reference's "N more rounds" semantics
+    end_iter = init_iter + num_boost_round if resumed_iter is None \
+        else max(num_boost_round, init_iter)
+    snapshot_freq = cfg_probe.snapshot_freq
     evaluation_result_list: List[Tuple] = []
     # opt-in jax.profiler device trace around the training loop — real
     # per-op timings (works over the remote tunnel, profiling/PROFILE.md)
@@ -344,15 +382,20 @@ def train(params: Dict, train_set: Dataset, num_boost_round: int = 100,
         except Exception as e:
             warnings.warn(f"profile_trace_dir set but the profiler trace "
                           f"could not start: {e}")
-    for i in range(init_iter, init_iter + num_boost_round):
+    for i in range(init_iter, end_iter):
         env = callback_mod.CallbackEnv(
             model=booster, params=params, iteration=i,
             begin_iteration=init_iter,
-            end_iteration=init_iter + num_boost_round,
+            end_iteration=end_iter,
             evaluation_result_list=None)
         for cb in callbacks_before:
             cb(env)
         finished = booster.update(fobj=fobj)
+        if snapshot_freq > 0 and cfg_probe.output_model \
+                and (i + 1) % snapshot_freq == 0:
+            from .reliability.resume import save_snapshot
+            save_snapshot(booster.gbdt, cfg_probe.output_model, i + 1,
+                          cfg_probe)
         evaluation_result_list = []
         if booster.gbdt.valid_metrics or booster.gbdt.training_metrics or feval:
             if booster.gbdt.training_metrics or (

@@ -107,6 +107,19 @@ class _LoopbackNet:
         return max(self.allgather(int(v)))
 
 
+def _gather(net, obj, what: str) -> List:
+    """``net.allgather`` with construction-phase context: a collective
+    failure (dead rank, deadline, abort broadcast — `io/net.py`) surfaces
+    WHERE in bin construction it happened and on which rank, so a
+    multi-process post-mortem starts from one log line."""
+    try:
+        return net.allgather(obj)
+    except ConnectionError as e:
+        raise ConnectionError(
+            f"distributed construction failed at the {what} allgather on "
+            f"rank {net.rank}: {e}") from e
+
+
 def partition_rows(num_rows: int, rank: int, num_machines: int,
                    pre_partition: bool) -> np.ndarray:
     """Row indices owned by ``rank`` — ``CheckOrPartition``
@@ -248,11 +261,11 @@ def distributed_construct(net, shard: np.ndarray, cfg: Config,
     n_local, f_local = shard.shape
 
     # ---- global shape agreement (fail fast on column disagreement)
-    fs = net.allgather(int(f_local))
+    fs = _gather(net, int(f_local), "feature-count")
     if len(set(fs)) != 1:
         raise ValueError(f"ranks disagree on feature count: {fs}")
     f = fs[0]
-    counts = net.allgather(int(n_local))
+    counts = _gather(net, int(n_local), "row-count")
     n_total = int(sum(counts))
     offset = int(sum(counts[:net.rank]))
     if global_rows is None:
@@ -276,7 +289,8 @@ def distributed_construct(net, shard: np.ndarray, cfg: Config,
     hit[hit] = sorted_rows[pos[hit]] == sample_idx[hit]
     local_pick = order[pos[hit]]
     local_sample = shard[local_pick]
-    parts = net.allgather((local_sample, sample_idx[hit]))
+    parts = _gather(net, (local_sample, sample_idx[hit]),
+                    "global-sample")
     gidx = np.concatenate([p[1] for p in parts]) if parts else np.zeros(0)
     stacked = np.concatenate([p[0] for p in parts if len(p[0])], axis=0) \
         if any(len(p[0]) for p in parts) else np.zeros((0, f))
@@ -306,7 +320,7 @@ def distributed_construct(net, shard: np.ndarray, cfg: Config,
 
     # ---- allgather serialized mappers (the `BinMapper::CopyTo` +
     # `Network::Allgather` step, `dataset_loader.cpp:917-950`)
-    gathered = net.allgather(json.dumps(local_mappers))
+    gathered = _gather(net, json.dumps(local_mappers), "bin-mapper")
     all_mappers = [BinMapper.from_dict(d)
                    for part in gathered for d in json.loads(part)]
     assert len(all_mappers) == f

@@ -21,6 +21,10 @@ boosting loop and tree learners report through:
     Schema v2 adds the optional ``serving`` section that the prediction
     service (`lightgbm_tpu/serving/`) reports QPS, queue/bin/traverse/unpad
     stage latency, batch occupancy and compile-cache hits through.
+    Schema v3 adds the ``reliability`` section — the process-wide failure
+    accounting (connect retries, collective aborts, shed requests, host
+    fallbacks, snapshots written/pruned, injected faults) maintained by
+    `lightgbm_tpu/reliability/metrics.py`.
 
 Device-side *time* attribution inside the fused tree program is out of
 scope for counters — that is what the opt-in ``profile_trace_dir``

@@ -42,7 +42,11 @@ TEL_NAMES = {
 
 # v2: optional "serving" section (QPS / stage latency / batch occupancy /
 # compile-cache — `lightgbm_tpu/serving/batcher.py` ServingStats.report)
-SCHEMA_VERSION = 2
+# v3: "reliability" section (process-wide failure accounting: retries,
+# sheds, fallbacks, aborts, snapshots, injected faults —
+# `lightgbm_tpu/reliability/metrics.py`); serving section gains
+# shed/fallback counters
+SCHEMA_VERSION = 3
 
 
 class Telemetry:
@@ -151,9 +155,13 @@ class Telemetry:
                         if self._iter_wall else 0.0),
         }
         coll = self._collectives(ledger, dev)
+        # failure accounting travels with every report (training AND
+        # serving) — the section is process-wide by design
+        from ..reliability.metrics import reliability_section
         return {"schema_version": SCHEMA_VERSION, "enabled": self.enabled,
                 "phases": phases, "iterations": it, "counters": counters,
-                "gauges": gauges, "collectives": coll}
+                "gauges": gauges, "collectives": coll,
+                "reliability": reliability_section()}
 
     def _collectives(self, ledger, dev: Dict[str, int]) -> Dict[str, Any]:
         sites = list(ledger.sites()) if ledger is not None else []

@@ -1,0 +1,37 @@
+"""Reliability subsystem: fault injection, crash-safe resume, degradation.
+
+The production story the ROADMAP's "heavy traffic" north star needs and
+the reference earns with its socket layer's retry/timeout/fail-loud
+discipline (`src/network/linkers_socket.cpp` TryBind/Connect loops).
+Four pieces:
+
+  * ``faults``  — deterministic named injection points armed via
+    ``LGBT_FAULTS`` / ``fault_spec`` so chaos tests drive the real socket,
+    training and serving failure paths (never mocks);
+  * ``resume``  — snapshot discovery/validation/retention behind
+    ``--resume`` crash-safe training (`engine.train`);
+  * ``degrade`` — the serving layer's bounded admission + load shedding
+    (`serving/server.py`);
+  * ``metrics`` — the process-wide counter table every retry, shed,
+    fallback and abort reports into, surfaced as the ``reliability``
+    section of the telemetry report (`observability/schema.json`).
+
+Hardened collectives (per-collective deadlines, frame-size caps, abort
+broadcast) live with the socket code in `io/net.py` and report here.
+"""
+
+from . import faults
+from .degrade import AdmissionController
+from .metrics import (rel_counters, rel_get, rel_inc, rel_reset,
+                      reliability_section)
+from .resume import (config_fingerprint, find_resume_snapshot,
+                     list_snapshots, prune_snapshots, save_snapshot,
+                     validate_snapshot)
+
+__all__ = [
+    "faults", "AdmissionController",
+    "rel_inc", "rel_get", "rel_counters", "rel_reset",
+    "reliability_section",
+    "config_fingerprint", "find_resume_snapshot", "list_snapshots",
+    "prune_snapshots", "save_snapshot", "validate_snapshot",
+]

@@ -1,0 +1,167 @@
+"""Deterministic fault-injection harness.
+
+Chaos tests must drive the REAL failure paths — the socket net's abort
+broadcast, the serving layer's host fallback — not mocks of them.  This
+module provides named injection points compiled into the hot paths at
+near-zero cost (one ``is None`` check when disarmed) and armed either
+programmatically (``arm``) or via the ``LGBT_FAULTS`` environment variable
+/ ``fault_spec`` config key, so subprocess workers inherit the plan.
+
+Spec grammar (semicolon-separated clauses)::
+
+    point[:key=value]*
+
+    net.send.drop:rank=1              # rank 1's next send dies (socket cut)
+    net.send.delay:rank=2:seconds=3   # rank 2's sends stall 3s
+    net.send.truncate:rank=1          # send half a frame then cut the socket
+    net.recv.corrupt_len              # recv sees a garbage length prefix
+    net.crash:rank=1:nth=2            # rank 1 hard-exits at its 2nd collective
+    serve.predict.fail:count=-1       # every device predict raises
+    serve.predict.delay:seconds=0.2   # device predict stalls (overload tests)
+
+Clause keys understood everywhere: ``rank`` (only fire for that rank;
+default any), ``nth`` (first firing hit, 1-based, counted per clause over
+MATCHING calls; default 1), ``count`` (how many firings; default 1, ``-1``
+= unlimited).  Remaining keys are passed to the injection site verbatim
+(e.g. ``seconds`` for delays).
+
+Determinism: firing depends only on the per-clause hit counter, never on
+time or randomness — the same arm + the same call sequence injects the
+same fault.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, Optional
+
+from .metrics import rel_inc
+
+ENV_VAR = "LGBT_FAULTS"
+
+
+class _Clause:
+    __slots__ = ("point", "rank", "nth", "count", "args", "hits", "fired")
+
+    def __init__(self, point: str, rank: Optional[int], nth: int,
+                 count: int, args: Dict[str, str]):
+        self.point = point
+        self.rank = rank
+        self.nth = max(int(nth), 1)
+        self.count = int(count)
+        self.args = args
+        self.hits = 0
+        self.fired = 0
+
+    def __repr__(self) -> str:  # pragma: no cover — debugging aid
+        return (f"_Clause({self.point}, rank={self.rank}, nth={self.nth}, "
+                f"count={self.count}, args={self.args})")
+
+
+def parse_spec(spec: str) -> List[_Clause]:
+    """Parse a fault spec string; raises ``ValueError`` naming the bad
+    clause so a typo'd injection never silently no-ops."""
+    clauses: List[_Clause] = []
+    for raw in spec.replace("\n", ";").split(";"):
+        raw = raw.strip()
+        if not raw:
+            continue
+        parts = raw.split(":")
+        point = parts[0].strip()
+        if not point or "=" in point:
+            raise ValueError(f"bad fault clause {raw!r}: first token must "
+                             f"be the injection point name")
+        rank: Optional[int] = None
+        nth = 1
+        count = 1
+        args: Dict[str, str] = {}
+        for kv in parts[1:]:
+            if "=" not in kv:
+                raise ValueError(f"bad fault clause {raw!r}: token {kv!r} "
+                                 f"is not key=value")
+            k, v = kv.split("=", 1)
+            k, v = k.strip(), v.strip()
+            if k == "rank":
+                rank = int(v)
+            elif k == "nth":
+                nth = int(v)
+            elif k == "count":
+                count = int(v)
+            else:
+                args[k] = v
+        clauses.append(_Clause(point, rank, nth, count, args))
+    return clauses
+
+
+_lock = threading.Lock()
+_plan: Optional[List[_Clause]] = None
+_env_loaded = False
+
+
+def arm(spec: str) -> None:
+    """Arm the plan from a spec string (replaces any existing plan)."""
+    global _plan, _env_loaded
+    with _lock:
+        _plan = parse_spec(spec)
+        _env_loaded = True
+
+
+def disarm() -> None:
+    """Remove every armed fault (and stop re-reading the environment)."""
+    global _plan, _env_loaded
+    with _lock:
+        _plan = []
+        _env_loaded = True
+
+
+def reset() -> None:
+    """Back to pristine: no plan, environment re-read on next ``fire``."""
+    global _plan, _env_loaded
+    with _lock:
+        _plan = None
+        _env_loaded = False
+
+
+def active() -> bool:
+    with _lock:
+        return bool(_plan)
+
+
+def fire(point: str, rank: Optional[int] = None) -> Optional[Dict[str, str]]:
+    """Called from an injection point.  Returns the clause's extra args
+    when a matching clause fires, else ``None``.  The caller performs the
+    actual fault (raise / sleep / exit) so the failure flows through the
+    real code path at the real location."""
+    global _plan, _env_loaded
+    plan = _plan
+    if plan is None:
+        with _lock:
+            if not _env_loaded:
+                spec = os.environ.get(ENV_VAR, "")
+                _plan = parse_spec(spec) if spec else []
+                _env_loaded = True
+            plan = _plan or []
+    if not plan:
+        return None
+    with _lock:
+        for c in plan:
+            if c.point != point:
+                continue
+            if c.rank is not None and rank is not None and c.rank != rank:
+                continue
+            if c.rank is not None and rank is None:
+                continue
+            c.hits += 1
+            if c.hits >= c.nth and (c.count < 0 or c.fired < c.count):
+                c.fired += 1
+                rel_inc("faults_injected")
+                rel_inc(f"fault.{point}")
+                return dict(c.args)
+    return None
+
+
+class InjectedFault(ConnectionError):
+    """Raised by injection sites that simulate a network failure — a
+    ``ConnectionError`` subclass so real error handling treats it exactly
+    like the organic failure it stands in for."""

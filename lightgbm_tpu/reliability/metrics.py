@@ -1,0 +1,50 @@
+"""Process-wide reliability counters.
+
+Every retry, shed request, host fallback, abort broadcast, injected fault
+and snapshot action in the package increments a counter here; the
+accumulated table surfaces as the ``reliability`` section of the JSON
+telemetry report (``observability/schema.json``) for BOTH training and
+serving reports, so a post-mortem always has the failure accounting next
+to the performance accounting.
+
+Deliberately global (one process = one failure domain): the serving
+server, the socket net and the training loop all feed the same table, the
+way the reference's ``Log::Warning`` stream is one stream.  Thread-safe;
+``reset()`` exists for tests.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+_lock = threading.Lock()
+_counters: Dict[str, int] = {}
+
+
+def rel_inc(name: str, v: int = 1) -> None:
+    """Increment reliability counter ``name`` by ``v``."""
+    with _lock:
+        _counters[name] = _counters.get(name, 0) + int(v)
+
+
+def rel_get(name: str) -> int:
+    with _lock:
+        return _counters.get(name, 0)
+
+
+def rel_counters() -> Dict[str, int]:
+    """Snapshot of all counters."""
+    with _lock:
+        return dict(_counters)
+
+
+def rel_reset() -> None:
+    """Zero every counter (tests)."""
+    with _lock:
+        _counters.clear()
+
+
+def reliability_section() -> Dict[str, Dict[str, int]]:
+    """The ``reliability`` section attached to every telemetry report."""
+    return {"counters": rel_counters()}

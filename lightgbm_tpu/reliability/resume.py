@@ -1,0 +1,217 @@
+"""Crash-safe training resume: snapshot discovery, validation, retention.
+
+``snapshot_freq > 0`` makes the training loop checkpoint the model text to
+``<output_model>.snapshot_iter_<k>`` (atomically — `gbdt.py`
+``save_model_to_file`` tempfile+rename) with a JSON sidecar recording the
+iteration and a fingerprint of the training-semantics config.  A killed
+run relaunched with ``--resume`` (config ``resume=true``) finds the newest
+snapshot that (a) parses as a complete model and (b) fingerprints to the
+same training config, seeds continue-training from it, and trains only the
+remaining iterations — producing model text identical to an uninterrupted
+run (`tests/test_reliability.py`).
+
+Validation is deliberately paranoid: a truncated file, a stale snapshot
+from a different config, or a missing trailer silently falls through to
+the next-newest candidate (with a warning) instead of resuming into a
+subtly wrong model.
+"""
+
+from __future__ import annotations
+
+import glob
+import hashlib
+import json
+import os
+import pickle
+import re
+import warnings
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .metrics import rel_inc
+
+_SNAP_RE = re.compile(r"\.snapshot_iter_(\d+)$")
+META_SUFFIX = ".meta.json"
+STATE_SUFFIX = ".state.pkl"
+
+# config fields with no bearing on what the trained trees look like —
+# everything else (objective, learning rates, bin config, learner knobs,
+# seeds, ...) participates in the fingerprint
+_VOLATILE_KEYS = frozenset({
+    "task", "output_model", "output_result", "input_model", "convert_model",
+    "convert_model_language", "resume", "snapshot_freq", "snapshot_keep",
+    "verbosity", "metric_freq", "telemetry", "telemetry_out",
+    "profile_trace_dir", "fault_spec", "num_iterations", "num_threads",
+    "time_out", "machine_list_filename", "machines", "local_listen_port",
+    "net_max_frame_mb", "net_collective_deadline_s",
+    "serve_host", "serve_port", "serve_max_batch_rows", "serve_deadline_ms",
+    "serve_min_bucket", "serve_warmup", "serve_max_inflight",
+    "is_parallel", "is_parallel_find_bin", "_FIELD_TYPES",
+})
+
+
+def config_fingerprint(cfg) -> str:
+    """Stable hash of the training-semantics subset of a ``Config`` —
+    ``num_iterations`` is excluded on purpose so a resumed run may extend
+    the round count."""
+    d = {k: v for k, v in cfg.to_dict().items() if k not in _VOLATILE_KEYS}
+    blob = json.dumps(d, sort_keys=True, default=str).encode()
+    return hashlib.sha256(blob).hexdigest()[:16]
+
+
+def snapshot_path(output_model: str, iteration: int) -> str:
+    return f"{output_model}.snapshot_iter_{int(iteration)}"
+
+
+def list_snapshots(output_model: str) -> List[Tuple[int, str]]:
+    """All ``<output_model>.snapshot_iter_*`` files as (iteration, path),
+    sorted by iteration ascending."""
+    out: List[Tuple[int, str]] = []
+    for p in glob.glob(glob.escape(output_model) + ".snapshot_iter_*"):
+        m = _SNAP_RE.search(p)
+        if m:
+            out.append((int(m.group(1)), p))
+    out.sort()
+    return out
+
+
+def write_snapshot_meta(path: str, iteration: int, cfg) -> None:
+    meta = {"iteration": int(iteration),
+            "config_fingerprint": config_fingerprint(cfg)}
+    tmp = path + META_SUFFIX + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(meta, fh)
+    os.replace(tmp, path + META_SUFFIX)
+
+
+def write_snapshot_state(path: str, gbdt) -> None:
+    """Exact-continuation sidecar: the training score array and the
+    bagging/feature/drop RNG states.  Model text alone is enough to
+    resume, but replaying scores from tree traversal re-orders float32
+    adds by a ulp — restoring the LIVE score array is what makes a
+    resumed run's model text bit-identical to an uninterrupted one."""
+    state: Dict[str, Any] = {
+        "score": np.asarray(gbdt.train_score.score),
+        "iter": int(gbdt.iter_),
+    }
+    for attr in ("_bag_rng", "_feat_rng", "_drop_rng"):
+        rng = getattr(gbdt, attr, None)
+        if rng is not None:
+            state[attr] = rng.get_state()
+    tmp = path + STATE_SUFFIX + ".tmp"
+    with open(tmp, "wb") as fh:
+        pickle.dump(state, fh, protocol=pickle.HIGHEST_PROTOCOL)
+    os.replace(tmp, path + STATE_SUFFIX)
+
+
+def load_snapshot_state(path: str) -> Optional[Dict[str, Any]]:
+    try:
+        with open(path + STATE_SUFFIX, "rb") as fh:
+            return pickle.load(fh)
+    except (OSError, pickle.UnpicklingError, EOFError, ValueError):
+        return None
+
+
+def restore_training_state(gbdt, state: Dict[str, Any]) -> bool:
+    """Overwrite the replayed training score / RNG states with the exact
+    snapshot values.  Returns False (leaving the replayed approximation
+    in place) when the score shape does not match — a resume against
+    different training data."""
+    score = state.get("score")
+    if score is not None:
+        cur = gbdt.train_score.score
+        if tuple(np.shape(score)) != tuple(cur.shape):
+            warnings.warn("snapshot score state shape "
+                          f"{np.shape(score)} != {tuple(cur.shape)}; "
+                          "resuming from the replayed score instead")
+            return False
+        import jax.numpy as jnp
+        gbdt.train_score.score = jnp.asarray(score)
+    for attr in ("_bag_rng", "_feat_rng", "_drop_rng"):
+        rng = getattr(gbdt, attr, None)
+        if rng is not None and attr in state:
+            rng.set_state(state[attr])
+    return True
+
+
+def validate_snapshot(path: str,
+                      fingerprint: Optional[str] = None) -> Tuple[bool, str]:
+    """(ok, reason).  A snapshot is valid when the model text is complete
+    (``end of trees`` trailer present — the atomic writer makes partial
+    files impossible, but a snapshot copied across machines may not be)
+    and, when a ``fingerprint`` is given and a sidecar exists, the sidecar
+    fingerprint matches.  A missing sidecar is accepted with a warning —
+    pre-sidecar snapshots stay resumable."""
+    try:
+        with open(path) as fh:
+            text = fh.read()
+    except OSError as e:
+        return False, f"unreadable: {e}"
+    if "end of trees" not in text:
+        return False, "truncated model text (no 'end of trees' trailer)"
+    meta_path = path + META_SUFFIX
+    if fingerprint is not None:
+        if os.path.exists(meta_path):
+            try:
+                with open(meta_path) as fh:
+                    meta = json.load(fh)
+            except (OSError, ValueError) as e:
+                return False, f"unreadable sidecar: {e}"
+            got = meta.get("config_fingerprint")
+            if got != fingerprint:
+                return False, (f"config fingerprint mismatch (snapshot "
+                               f"{got}, current {fingerprint})")
+        else:
+            warnings.warn(f"snapshot {path} has no metadata sidecar; "
+                          f"resuming without a config-fingerprint check")
+    return True, "ok"
+
+
+def find_resume_snapshot(output_model: str,
+                         cfg=None) -> Optional[Tuple[int, str]]:
+    """Newest valid snapshot for ``output_model`` as (iteration, path), or
+    ``None``.  Invalid candidates are skipped newest-first with a warning
+    naming the reason."""
+    if not output_model:
+        return None
+    fp = config_fingerprint(cfg) if cfg is not None else None
+    for iteration, path in reversed(list_snapshots(output_model)):
+        ok, reason = validate_snapshot(path, fp)
+        if ok:
+            return iteration, path
+        warnings.warn(f"skipping snapshot {path}: {reason}")
+        rel_inc("snapshots_rejected")
+    return None
+
+
+def prune_snapshots(output_model: str, keep: int) -> List[str]:
+    """Delete all but the newest ``keep`` snapshots (and their sidecars).
+    ``keep <= 0`` keeps everything.  Returns the removed paths."""
+    if keep <= 0:
+        return []
+    removed: List[str] = []
+    snaps = list_snapshots(output_model)
+    for _it, path in snaps[:max(len(snaps) - keep, 0)]:
+        for p in (path, path + META_SUFFIX, path + STATE_SUFFIX):
+            try:
+                os.unlink(p)
+                if p == path:
+                    removed.append(p)
+            except OSError:
+                pass
+    if removed:
+        rel_inc("snapshots_pruned", len(removed))
+    return removed
+
+
+def save_snapshot(gbdt, output_model: str, iteration: int, cfg) -> str:
+    """Atomic snapshot write + sidecar + retention in one call — the ONE
+    entry point both training loops (`engine.train`, `GBDT.train`) use."""
+    path = snapshot_path(output_model, iteration)
+    gbdt.save_model_to_file(path)
+    write_snapshot_meta(path, iteration, cfg)
+    write_snapshot_state(path, gbdt)
+    rel_inc("snapshots_written")
+    prune_snapshots(output_model, int(getattr(cfg, "snapshot_keep", 0)))
+    return path

@@ -50,7 +50,7 @@ class ServingStats:
     under ``serving.stage_ms``.
     """
 
-    STAGES = ("queue", "pad", "bin", "traverse", "unpad")
+    STAGES = ("queue", "pad", "bin", "traverse", "unpad", "fallback")
 
     def __init__(self):
         self.tel = Telemetry(True)
@@ -64,6 +64,9 @@ class ServingStats:
         self.bucket_batches: Dict[int, int] = {}
         self.cache_hits = 0
         self.cache_misses = 0
+        self.shed = 0
+        self.fallback_batches = 0
+        self.fallback_rows = 0
 
     def stage(self, name: str):
         return self.tel.phase(f"serve_{name}")
@@ -91,6 +94,18 @@ class ServingStats:
             else:
                 self.cache_misses += 1
 
+    def record_shed(self) -> None:
+        with self._lock:
+            self.shed += 1
+
+    def record_fallback(self, rows: int) -> None:
+        from ..reliability.metrics import rel_inc
+        with self._lock:
+            self.fallback_batches += 1
+            self.fallback_rows += int(rows)
+        rel_inc("serve.host_fallback_batches")
+        rel_inc("serve.host_fallback_rows", int(rows))
+
     def serving_section(self, models: Optional[Dict[str, int]] = None,
                         jit_entries: Optional[int] = None) -> Dict[str, Any]:
         with self._lock:
@@ -116,6 +131,9 @@ class ServingStats:
                 "buckets": {str(b): c
                             for b, c in sorted(self.bucket_batches.items())},
                 "models": dict(models or {}),
+                "shed": self.shed,
+                "fallback_batches": self.fallback_batches,
+                "fallback_rows": self.fallback_rows,
             }
 
     def report(self, models: Optional[Dict[str, int]] = None,
@@ -146,13 +164,22 @@ class MicroBatcher:
     matrix whose first ``m`` rows are real and returns host scores for
     those rows (``(m,)`` or ``(m, K)``).  It runs ONLY on the worker
     thread, so the device is never entered concurrently.
+
+    ``fallback_fn`` (same signature) is the graceful-degradation path:
+    when ``predict_fn`` raises — a device fault, an OOM, an injected
+    ``serve.predict.fail`` — the batch is re-scored through it (the host
+    numpy traversal in practice) instead of failing every rider, and the
+    fallback is counted (`reliability/metrics.py`).
     """
 
     def __init__(self, predict_fn: Callable[[np.ndarray, int], np.ndarray],
                  num_features: int, max_batch_rows: int = 1024,
                  deadline_ms: float = 2.0, min_bucket: int = 16,
-                 stats: Optional[ServingStats] = None):
+                 stats: Optional[ServingStats] = None,
+                 fallback_fn: Optional[Callable[[np.ndarray, int],
+                                                np.ndarray]] = None):
         self.predict_fn = predict_fn
+        self.fallback_fn = fallback_fn
         self.num_features = int(num_features)
         self.max_rows = next_pow2(max_batch_rows)
         self.min_bucket = min(next_pow2(min_bucket), self.max_rows)
@@ -248,7 +275,14 @@ class MicroBatcher:
                 for r in reqs:
                     Xpad[ofs:ofs + r.n] = r.X
                     ofs += r.n
-            scores = self.predict_fn(Xpad, m)
+            try:
+                scores = self.predict_fn(Xpad, m)
+            except BaseException:
+                if self.fallback_fn is None:
+                    raise
+                with self.stats.stage("fallback"):
+                    scores = self.fallback_fn(Xpad, m)
+                self.stats.record_fallback(m)
             ofs = 0
             for r in reqs:
                 r.result = scores[ofs:ofs + r.n]

@@ -55,6 +55,14 @@ class ServingModel:
     def predict_padded(self, Xpad: np.ndarray, m: int) -> np.ndarray:
         """Raw scores of the first ``m`` rows of a padded
         ``(bucket, num_features)`` matrix; stages timed into ``stats``."""
+        from ..reliability import faults
+        f = faults.fire("serve.predict.delay")
+        if f is not None:
+            import time as _time
+            _time.sleep(float(f.get("seconds", 0.1)))
+        if faults.fire("serve.predict.fail") is not None:
+            raise RuntimeError("injected fault serve.predict.fail "
+                               "(device predict path)")
         bucket = Xpad.shape[0]
         self.stats.record_compile_cache(hit=bucket in self._warmed)
         self._warmed.add(bucket)
@@ -95,6 +103,13 @@ class ServingModel:
                 int(_predict_all._cache_size())
         except Exception:
             return None
+
+    def host_fallback(self, Xpad: np.ndarray, m: int) -> np.ndarray:
+        """Degraded-mode scoring for a padded batch: the host numpy
+        traversal over the real rows, same output convention as
+        ``predict_padded`` — the batcher swaps to this when the device
+        path raises (`batcher.MicroBatcher` ``fallback_fn``)."""
+        return self.host_raw(Xpad[:m])
 
     def host_raw(self, X: np.ndarray) -> np.ndarray:
         """Reference host traversal (per-tree numpy), the verify oracle."""

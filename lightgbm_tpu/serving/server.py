@@ -14,7 +14,16 @@ Ops (dict in, dict out; ``{"ok": False, "error": ...}`` on failure):
   * ``swap``     — ``{"op", "model", "model_str"}`` → load/verify/hot-swap
     a new model text; the old version serves until the swap commits
   * ``stats``    — full telemetry report (``serving`` schema section)
+  * ``health``   — readiness probe, distinct from ``ping`` liveness:
+    registered models + admission state (inflight/capacity/shedding);
+    accurate under overload
   * ``ping`` / ``shutdown``
+
+Overload never drops a connection: past ``max_inflight`` concurrently
+admitted predicts, requests shed with a structured
+``{"ok": False, "error": "overloaded", "shed": True}`` frame
+(`reliability/degrade.py`), and a device-path failure degrades to the
+host numpy traversal instead of erroring the batch (``fallback_fn``).
 
 Start via ``Booster.serve()`` or ``python -m lightgbm_tpu serve
 input_model=model.txt``.
@@ -29,6 +38,7 @@ from typing import Any, Dict, Optional
 import numpy as np
 
 from ..io.net import recv_frame, send_frame
+from ..reliability.degrade import AdmissionController
 from .batcher import MicroBatcher, ServingStats, bucket_ladder
 from .registry import ModelRegistry
 
@@ -40,7 +50,8 @@ class PredictionServer:
                  host: str = "127.0.0.1", port: int = 0,
                  max_batch_rows: int = 256, deadline_ms: float = 2.0,
                  min_bucket: int = 32, warmup: bool = True,
-                 telemetry_out: str = "", request_timeout: float = 60.0):
+                 telemetry_out: str = "", request_timeout: float = 60.0,
+                 max_inflight: int = 64):
         self.host = host
         self.port = int(port)
         self.max_batch_rows = int(max_batch_rows)
@@ -48,6 +59,7 @@ class PredictionServer:
         self.min_bucket = int(min_bucket)
         self.telemetry_out = telemetry_out
         self.request_timeout = float(request_timeout)
+        self.admission = AdmissionController(max_inflight)
         self.stats = ServingStats()
         self.buckets = bucket_ladder(min_bucket, max_batch_rows)
         self.registry = registry or ModelRegistry(
@@ -123,12 +135,19 @@ class PredictionServer:
                 def predict_fn(Xpad, m, _name=name):
                     return self.registry.get(_name).predict_padded(Xpad, m)
 
+                # graceful degradation: a device-path failure re-scores
+                # the batch through the host numpy traversal (counted in
+                # the reliability section) instead of erroring every rider
+                def fallback_fn(Xpad, m, _name=name):
+                    return self.registry.get(_name).host_fallback(Xpad, m)
+
                 b = MicroBatcher(
                     predict_fn,
                     num_features=self.registry.get(name).num_features,
                     max_batch_rows=self.max_batch_rows,
                     deadline_ms=self.deadline_ms,
-                    min_bucket=self.min_bucket, stats=self.stats).start()
+                    min_bucket=self.min_bucket, stats=self.stats,
+                    fallback_fn=fallback_fn).start()
                 self._batchers[name] = b
             return b
 
@@ -175,13 +194,35 @@ class PredictionServer:
         op = msg["op"]
         if op == "ping":
             return {"ok": True}
+        if op == "health":
+            # readiness, distinct from liveness (`ping`): servable models
+            # exist and the server is not stopping.  Stays ACCURATE under
+            # overload — a saturated server is alive and ready, it is just
+            # shedding; clients and balancers read that from `shedding`
+            models = self.registry.versions()
+            return {"ok": True,
+                    "ready": bool(models) and not self._stop.is_set(),
+                    "models": models,
+                    **self.admission.snapshot()}
         if op == "predict":
-            name = msg.get("model", "default")
-            model = self.registry.get(name)
-            X = np.atleast_2d(np.asarray(msg["data"], dtype=np.float64))
-            raw = self._batcher(name).submit(X, timeout=self.request_timeout)
-            scores = model.convert_output(raw, bool(msg.get("raw_score")))
-            return {"ok": True, "scores": np.asarray(scores)}
+            # bounded admission: past capacity we answer IMMEDIATELY with
+            # a structured shed frame — never a queue-until-timeout that
+            # looks like a dropped connection from the outside
+            if not self.admission.try_acquire():
+                self.stats.record_shed()
+                return {"ok": False, "error": "overloaded", "shed": True,
+                        "inflight": self.admission.inflight,
+                        "capacity": self.admission.capacity}
+            try:
+                name = msg.get("model", "default")
+                model = self.registry.get(name)
+                X = np.atleast_2d(np.asarray(msg["data"], dtype=np.float64))
+                raw = self._batcher(name).submit(
+                    X, timeout=self.request_timeout)
+                scores = model.convert_output(raw, bool(msg.get("raw_score")))
+                return {"ok": True, "scores": np.asarray(scores)}
+            finally:
+                self.admission.release()
         if op == "swap":
             version = self.registry.load(
                 msg.get("model", "default"), model_str=msg.get("model_str"),
@@ -215,6 +256,10 @@ class ServingClient:
 
     def ping(self) -> bool:
         return self._call({"op": "ping"})["ok"]
+
+    def health(self) -> Dict[str, Any]:
+        """Readiness + admission state (see ``health`` op)."""
+        return self._call({"op": "health"})
 
     def predict(self, X, model: str = "default",
                 raw_score: bool = False) -> np.ndarray:

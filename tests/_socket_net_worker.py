@@ -1,13 +1,55 @@
-"""Subprocess worker for the multi-process SocketNet test: bins ONE
-mod-partitioned shard of a real data file over the TCP net and pickles the
-resulting mapper table + binned shard for the parent to compare."""
+"""Subprocess worker for the multi-process SocketNet tests.
 
+Two modes:
+
+  * default — bins ONE mod-partitioned shard of a real data file over the
+    TCP net and pickles the resulting mapper table + binned shard for the
+    parent to compare;
+  * ``chaos rank n port rounds out.json`` — runs ``rounds`` allgathers
+    with a short per-collective deadline; the ``LGBT_FAULTS`` environment
+    (inherited from the parent) injects crashes/drops into specific ranks
+    (`lightgbm_tpu/reliability/faults.py`).  Writes ``{"ok": ...,
+    "error": ..., "fail_latency_s": ...}`` to ``out.json`` so the parent
+    can assert that SURVIVORS of a killed rank raise the root cause
+    within the deadline (exit code 3 on collective failure).
+"""
+
+import json
 import os
 import pickle
 import sys
+import time
 
 os.environ.setdefault("JAX_PLATFORM_NAME", "cpu")
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def chaos_main():
+    rank = int(sys.argv[2])
+    num_machines = int(sys.argv[3])
+    port = int(sys.argv[4])
+    rounds = int(sys.argv[5])
+    out_path = sys.argv[6]
+
+    from lightgbm_tpu.io.net import SocketNet
+
+    result = {"ok": False, "rank": rank, "error": "", "fail_latency_s": -1.0}
+    code = 3
+    t_fail = time.monotonic()
+    try:
+        with SocketNet(rank, num_machines, ("127.0.0.1", port),
+                       timeout=30.0, collective_deadline=5.0) as net:
+            for i in range(rounds):
+                t_fail = time.monotonic()   # latency from collective entry
+                net.allgather(("payload", rank, i))
+        result["ok"] = True
+        code = 0
+    except BaseException as e:  # noqa: BLE001 — reported to the parent
+        result["error"] = f"{type(e).__name__}: {e}"
+        result["fail_latency_s"] = time.monotonic() - t_fail
+    with open(out_path, "w") as fh:
+        json.dump(result, fh)
+    sys.exit(code)
 
 
 def main():
@@ -42,4 +84,7 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) > 1 and sys.argv[1] == "chaos":
+        chaos_main()
+    else:
+        main()

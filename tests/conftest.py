@@ -42,10 +42,11 @@ def rng():
     return np.random.RandomState(42)
 
 
-# per-test timeout for serving-marked tests (threads + sockets): a hung
-# accept loop or a lost batcher event must fail ONE test, not stall the
-# tier-1 suite.  SIGALRM fires in the main thread, which is exactly where
-# the test body blocks; no external pytest-timeout dependency needed.
+# per-test timeout for serving- and chaos-marked tests (threads + sockets
+# + injected faults): a hung accept loop, a lost batcher event or an
+# injected network hang must fail ONE test, not stall the tier-1 suite.
+# SIGALRM fires in the main thread, which is exactly where the test body
+# blocks; no external pytest-timeout dependency needed.
 import signal  # noqa: E402
 
 _SERVING_TIMEOUT_S = 120
@@ -53,7 +54,8 @@ _SERVING_TIMEOUT_S = 120
 
 @pytest.hookimpl(hookwrapper=True)
 def pytest_runtest_call(item):
-    marker = item.get_closest_marker("serving")
+    marker = item.get_closest_marker("serving") \
+        or item.get_closest_marker("chaos")
     if marker is None or not hasattr(signal, "SIGALRM"):
         yield
         return
@@ -61,7 +63,7 @@ def pytest_runtest_call(item):
 
     def _on_alarm(signum, frame):
         raise TimeoutError(
-            f"serving test exceeded its {timeout}s SIGALRM timeout")
+            f"{marker.name} test exceeded its {timeout}s SIGALRM timeout")
 
     old = signal.signal(signal.SIGALRM, _on_alarm)
     signal.alarm(timeout)

@@ -1,0 +1,426 @@
+"""Reliability subsystem chaos suite (`lightgbm_tpu/reliability/`).
+
+Fault injection drives the REAL code paths: hardened SocketNet collectives
+(frame cap, deadlines, abort broadcast, killed-rank subprocess), crash-safe
+training resume (bit-identical model text), serving graceful degradation
+(load shedding, health probe, host fallback) and the ``reliability``
+telemetry section.  Every test is ``chaos``-marked so conftest's SIGALRM
+per-test timeout guarantees an injected hang can never stall the tier-1
+run.
+"""
+
+import json
+import os
+import socket
+import struct
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.io.net import (SocketNet, parse_machine_list, recv_frame,
+                                 send_frame)
+from lightgbm_tpu.observability import validate_report
+from lightgbm_tpu.reliability import (faults, find_resume_snapshot,
+                                      list_snapshots, rel_counters, rel_get,
+                                      rel_reset, validate_snapshot)
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.disarm()
+    rel_reset()
+    yield
+    faults.disarm()
+    rel_reset()
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+# -- frame guard / parse errors (satellites) ---------------------------------
+
+def test_recv_frame_rejects_oversize_header():
+    """A corrupt 8-byte length prefix must raise, not allocate."""
+    a, b = socket.socketpair()
+    try:
+        a.sendall(struct.pack("<Q", 1 << 40) + b"junk")
+        with pytest.raises(ConnectionError, match="max_frame_bytes"):
+            recv_frame(b, max_bytes=1 << 20)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_recv_frame_corrupt_len_fault_injection():
+    """``net.recv.corrupt_len`` drives the guard through a REAL frame."""
+    faults.arm("net.recv.corrupt_len")
+    a, b = socket.socketpair()
+    try:
+        send_frame(a, {"real": "payload"})
+        with pytest.raises(ConnectionError, match="corrupt length prefix"):
+            recv_frame(b)
+        assert rel_get("net.frames_rejected_oversize") == 1
+        assert rel_get("fault.net.recv.corrupt_len") == 1
+    finally:
+        a.close()
+        b.close()
+
+
+def test_roundtrip_frame_still_works():
+    a, b = socket.socketpair()
+    try:
+        send_frame(a, {"x": np.arange(4)})
+        out = recv_frame(b)
+        np.testing.assert_array_equal(out["x"], np.arange(4))
+    finally:
+        a.close()
+        b.close()
+
+
+def test_parse_machine_list_error_context(tmp_path):
+    p = tmp_path / "mlist.txt"
+    p.write_text("# comment\n127.0.0.1 9000\nonlyonetoken\n")
+    with pytest.raises(ValueError) as ei:
+        parse_machine_list(str(p))
+    msg = str(ei.value)
+    assert str(p) in msg and ":3:" in msg and "onlyonetoken" in msg
+
+    p.write_text("127.0.0.1 notaport\n")
+    with pytest.raises(ValueError, match="not an integer"):
+        parse_machine_list(str(p))
+    p.write_text("127.0.0.1 99999\n")
+    with pytest.raises(ValueError, match="outside"):
+        parse_machine_list(str(p))
+
+
+def test_fault_spec_parse_errors():
+    with pytest.raises(ValueError):
+        faults.parse_spec("rank=1")          # no point name
+    with pytest.raises(ValueError):
+        faults.parse_spec("net.crash:badtoken")
+    clauses = faults.parse_spec("net.crash:rank=1:nth=2; serve.predict.fail")
+    assert len(clauses) == 2 and clauses[0].rank == 1
+
+
+# -- hardened collectives (threaded ranks) -----------------------------------
+
+def _run_ranks(n, port, body, deadline=5.0):
+    """Run ``body(net, rank)`` on n threaded SocketNet ranks; returns the
+    per-rank exception (or None)."""
+    errs = [None] * n
+
+    def run(r):
+        try:
+            with SocketNet(r, n, ("127.0.0.1", port), timeout=15,
+                           collective_deadline=deadline) as net:
+                body(net, r)
+        except BaseException as e:  # noqa: BLE001 — asserted by caller
+            errs[r] = e
+
+    ts = [threading.Thread(target=run, args=(r,), daemon=True)
+          for r in range(n)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=30)
+    return errs
+
+
+def test_send_drop_aborts_every_rank():
+    """Mid-collective socket death on rank 1: rank 0 names rank 1, rank 2
+    learns the root cause from the abort broadcast — nobody hangs."""
+    faults.arm("net.send.drop:rank=1:nth=2")   # hello is rank 1's send #1
+
+    def body(net, r):
+        net.allgather(r)
+        net.allgather(r + 100)
+
+    t0 = time.monotonic()
+    errs = _run_ranks(3, _free_port(), body)
+    assert time.monotonic() - t0 < 10
+    assert all(isinstance(e, ConnectionError) for e in errs)
+    assert "rank 1" in str(errs[0])
+    assert "injected" in str(errs[1])
+    assert "aborted by the master" in str(errs[2])
+    assert "rank 1" in str(errs[2])
+    assert rel_get("net.aborts_sent") >= 1
+    assert rel_get("net.aborts_received") >= 1
+
+
+def test_collective_deadline_names_late_rank():
+    """A wedged (not dead) rank trips the per-collective deadline on every
+    survivor, with the late rank named."""
+    faults.arm("net.send.delay:rank=2:nth=2:seconds=6")
+
+    def body(net, r):
+        net.allgather(r)
+
+    t0 = time.monotonic()
+    errs = _run_ranks(3, _free_port(), body, deadline=1.0)
+    elapsed = time.monotonic() - t0
+    assert isinstance(errs[0], ConnectionError) and "rank 2" in str(errs[0])
+    assert isinstance(errs[1], ConnectionError) \
+        and "aborted by the master" in str(errs[1])
+    assert elapsed < 12          # delayed thread wakes at ~6s, fails fast
+
+
+def test_sequence_mismatch_still_detected():
+    def body(net, r):
+        if r == 1:
+            net._seq = 5                     # rank 1 desynced (ran ahead)
+        net.allgather(r)
+
+    errs = _run_ranks(2, _free_port(), body)
+    assert errs[0] is not None and "sequence mismatch" in str(errs[0])
+    assert errs[1] is not None and "aborted by the master" in str(errs[1])
+
+
+def test_killed_rank_subprocess_survivors_raise_within_deadline(tmp_path):
+    """Acceptance (a): rank 1 hard-crashes mid-allgather (os._exit via
+    ``net.crash``); ranks 0 and 2 raise within 5s naming rank 1."""
+    port = _free_port()
+    worker = os.path.join(os.path.dirname(__file__),
+                          "_socket_net_worker.py")
+    env = dict(os.environ, LGBT_FAULTS="net.crash:rank=1:nth=2",
+               JAX_PLATFORMS="cpu")
+    outs = [tmp_path / f"rank{r}.json" for r in range(3)]
+    procs = [subprocess.Popen(
+        [sys.executable, worker, "chaos", str(r), "3", str(port), "3",
+         str(outs[r])], env=env) for r in range(3)]
+    codes = [p.wait(timeout=90) for p in procs]
+    assert codes[1] == 17, "rank 1 must have hard-crashed"
+    for r in (0, 2):
+        assert codes[r] == 3, f"rank {r} must fail its collective"
+        res = json.loads(outs[r].read_text())
+        assert not res["ok"]
+        assert "rank 1" in res["error"], res["error"]
+        assert 0 <= res["fail_latency_s"] < 5.0, res
+
+
+# -- crash-safe resume (acceptance (b) + satellites) -------------------------
+
+_TRAIN_P = {"objective": "regression", "num_leaves": 15,
+            "min_data_in_leaf": 5, "verbosity": -1}
+
+
+def _data(rng, n=400):
+    X = rng.randn(n, 8)
+    y = X[:, 0] + 0.5 * X[:, 1] ** 2 + rng.randn(n) * 0.1
+    return X, y
+
+
+def _train_text(X, y, rounds, **extra):
+    p = dict(_TRAIN_P, **extra)
+    bst = lgb.train(dict(p), lgb.Dataset(X, label=y, params=dict(p)),
+                    rounds, verbose_eval=False)
+    return bst
+
+
+def test_resume_bit_identical_model_text(rng, tmp_path):
+    """Killed at iteration 4 of 8, relaunched with resume: the final model
+    text is IDENTICAL to an uninterrupted 8-iteration run."""
+    X, y = _data(rng)
+    out = str(tmp_path / "model.txt")
+    full = _train_text(X, y, 8).model_to_string()
+    # "killed" run: only 4 of the 8 iterations happen, snapshots every 2
+    _train_text(X, y, 4, output_model=out, snapshot_freq=2)
+    assert [it for it, _ in list_snapshots(out)] == [2, 4]
+    resumed = _train_text(X, y, 8, output_model=out, snapshot_freq=2,
+                          resume=True)
+    assert resumed.num_trees() == 8
+    assert resumed.model_to_string() == full
+    assert rel_get("resume_runs") == 1
+
+
+def test_resume_bit_identical_with_bagging(rng, tmp_path):
+    """RNG-consuming configs (bagging + feature_fraction) resume exactly:
+    the state sidecar restores the random streams."""
+    X, y = _data(rng)
+    out = str(tmp_path / "model.txt")
+    extra = {"bagging_fraction": 0.8, "bagging_freq": 1,
+             "feature_fraction": 0.7}
+    full = _train_text(X, y, 8, **extra).model_to_string()
+    _train_text(X, y, 5, output_model=out, snapshot_freq=3, **extra)
+    resumed = _train_text(X, y, 8, output_model=out, snapshot_freq=3,
+                          resume=True, **extra)
+    assert resumed.model_to_string() == full
+
+
+def test_snapshot_retention_keeps_last_k(rng, tmp_path):
+    X, y = _data(rng, n=200)
+    out = str(tmp_path / "model.txt")
+    _train_text(X, y, 6, output_model=out, snapshot_freq=1, snapshot_keep=2)
+    assert [it for it, _ in list_snapshots(out)] == [5, 6]
+    # sidecars pruned along with the snapshots
+    leftovers = [f for f in os.listdir(tmp_path)
+                 if "snapshot_iter" in f
+                 and not (f.endswith("_5") or f.endswith("_6")
+                          or "_5." in f or "_6." in f)]
+    assert leftovers == []
+
+
+def test_resume_rejects_fingerprint_mismatch(rng, tmp_path):
+    """A snapshot from a DIFFERENT training config is never resumed."""
+    X, y = _data(rng, n=200)
+    out = str(tmp_path / "model.txt")
+    _train_text(X, y, 4, output_model=out, snapshot_freq=2)
+    from lightgbm_tpu.config import Config
+    other = Config.from_params(dict(_TRAIN_P, learning_rate=0.31))
+    with pytest.warns(UserWarning, match="skipping snapshot"):
+        assert find_resume_snapshot(out, other) is None
+    same = Config.from_params(dict(_TRAIN_P))
+    found = find_resume_snapshot(out, same)
+    assert found is not None and found[0] == 4
+
+
+def test_truncated_snapshot_falls_back_to_older(rng, tmp_path):
+    X, y = _data(rng, n=200)
+    out = str(tmp_path / "model.txt")
+    _train_text(X, y, 4, output_model=out, snapshot_freq=2)
+    snaps = dict(list_snapshots(out))
+    # truncate the newest snapshot mid-file (no 'end of trees' trailer)
+    text = open(snaps[4]).read()
+    open(snaps[4], "w").write(text[:len(text) // 3])
+    ok, reason = validate_snapshot(snaps[4])
+    assert not ok and "truncated" in reason
+    from lightgbm_tpu.config import Config
+    with pytest.warns(UserWarning, match="skipping snapshot"):
+        found = find_resume_snapshot(out, Config.from_params(dict(_TRAIN_P)))
+    assert found is not None and found[0] == 2
+
+
+# -- serving graceful degradation (acceptance (c)) ---------------------------
+
+def _serve_booster(rng):
+    X = rng.randn(600, 4)
+    y = (X[:, 0] + X[:, 1] > 0).astype(float)
+    p = {"objective": "binary", "num_leaves": 7, "min_data_in_leaf": 10,
+         "verbosity": -1}
+    return lgb.train(p, lgb.Dataset(X, label=y, params=dict(p)), 5,
+                     verbose_eval=False), X
+
+
+def test_serving_overload_sheds_structured_and_recovers(rng):
+    """Acceptance (c): synthetic overload sheds with structured
+    ``{"error": "overloaded"}`` frames (never a dropped connection), the
+    readiness probe stays accurate throughout, and service recovers with
+    zero recompiles outside the warmed buckets."""
+    bst, X = _serve_booster(rng)
+    server = bst.serve(port=0, max_batch_rows=64, min_bucket=32,
+                       deadline_ms=1.0, max_inflight=2)
+    try:
+        from lightgbm_tpu.serving import ServingClient
+        with ServingClient(server.host, server.port) as probe:
+            assert probe.health()["ready"] is True
+            misses_before = probe.stats()["serving"]["compile_cache"]["misses"]
+
+        # slow every device batch so admission saturates
+        faults.arm("serve.predict.delay:seconds=0.25:count=-1")
+        results = []
+        lock = threading.Lock()
+
+        def hammer():
+            with ServingClient(server.host, server.port, timeout=30) as c:
+                # raw frame so the structured shed response is observable
+                send_frame(c._sock, {"op": "predict",
+                                     "data": X[:4], "raw_score": True})
+                resp = recv_frame(c._sock)
+                with lock:
+                    results.append(resp)
+
+        ts = [threading.Thread(target=hammer) for _ in range(10)]
+        for t in ts:
+            t.start()
+        # readiness stays accurate while saturated: alive + ready
+        with ServingClient(server.host, server.port) as probe:
+            h = probe.health()
+            assert h["ready"] is True and h["capacity"] == 2
+        for t in ts:
+            t.join(timeout=30)
+
+        assert len(results) == 10, "every request got a structured frame"
+        shed = [r for r in results if not r.get("ok")]
+        served = [r for r in results if r.get("ok")]
+        assert shed and served
+        assert all(r["error"] == "overloaded" and r["shed"] for r in shed)
+        faults.disarm()
+
+        # recovery: normal predicts, shedding off, no new compiles
+        with ServingClient(server.host, server.port) as c:
+            scores = c.predict(X[:8], raw_score=True)
+            assert scores.shape == (8,)
+            h = c.health()
+            assert h["ready"] is True and h["shedding"] is False
+            rep = c.stats()
+            srv = rep["serving"]
+            assert srv["shed"] == len(shed)
+            assert srv["compile_cache"]["misses"] == misses_before
+            assert rep["reliability"]["counters"]["serve.requests_shed"] \
+                == len(shed)
+            assert validate_report(rep) == []
+    finally:
+        faults.disarm()
+        server.stop()
+
+
+def test_serving_device_fault_host_fallback(rng):
+    """A failing device predict path degrades to the host numpy traversal
+    — correct scores, counted fallbacks, no failed requests."""
+    bst, X = _serve_booster(rng)
+    server = bst.serve(port=0, max_batch_rows=64, min_bucket=32)
+    try:
+        faults.arm("serve.predict.fail:count=-1")
+        from lightgbm_tpu.serving import ServingClient
+        with ServingClient(server.host, server.port) as c:
+            got = c.predict(X[:16], raw_score=True)
+            want = np.zeros(16)
+            for t in bst.gbdt.models:
+                want += t.predict(np.ascontiguousarray(X[:16]))
+            np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+            rep = c.stats()
+        assert rep["serving"]["fallback_batches"] >= 1
+        assert rep["serving"]["fallback_rows"] >= 16
+        assert rel_get("serve.host_fallback_batches") >= 1
+        assert rep["reliability"]["counters"]["fault.serve.predict.fail"] >= 1
+    finally:
+        faults.disarm()
+        server.stop()
+
+
+def test_health_readiness_requires_model():
+    """Readiness (health) is distinct from liveness (ping): a server with
+    no registered model pings fine but is NOT ready."""
+    from lightgbm_tpu.serving import PredictionServer, ServingClient
+    server = PredictionServer(port=0, warmup=False).start()
+    try:
+        with ServingClient(server.host, server.port) as c:
+            assert c.ping() is True
+            h = c.health()
+            assert h["ready"] is False and h["models"] == {}
+    finally:
+        server.stop()
+
+
+# -- telemetry section -------------------------------------------------------
+
+def test_reliability_section_in_training_report(rng):
+    X, y = _data(rng, n=200)
+    p = dict(_TRAIN_P, telemetry=True)
+    bst = lgb.train(dict(p), lgb.Dataset(X, label=y, params=dict(p)), 3,
+                    verbose_eval=False)
+    rep = bst.get_telemetry()
+    assert rep["schema_version"] == 3
+    assert "counters" in rep["reliability"]
+    assert validate_report(rep) == []
