@@ -1,0 +1,42 @@
+"""Program-invariant static analysis for the lightgbm_tpu tree.
+
+The reference C++ LightGBM keeps a 20k-LoC trainer honest with compiler
+diagnostics and sanitizers; this package is the JAX port's equivalent — a
+correctness-tooling layer that catches the regression classes PRs 1-4
+fixed by hand (K collectives per stall event, corrupt length prefixes
+driving multi-GB allocs, recompiles on every new row count) at ANALYSIS
+time instead of in chaos tests or on-device profiles.
+
+Four passes, one gate:
+
+  * ``jaxpr_lint``  — trace the wave tree step, the sharded learners and
+    the serving binner/traversal programs; walk the closed jaxprs and
+    enforce per-program collective-site budgets (``budgets.json``), no
+    host callbacks in hot loops, no f64 when x64 is off, and a
+    baked-constant size ceiling.
+  * ``recompile``   — fingerprint jit caches; fail when a warmed serving
+    bucket or training step retraces.
+  * ``races``       — AST lock-acquisition graph across the serving +
+    network modules; flag lock-order cycles and fields mutated both
+    inside and outside a lock.  Plus a runtime lock-discipline monitor
+    usable from tests.
+  * ``lint``        — repo-specific AST rules (socket timeouts, atomic
+    writes, seeded RNGs, no bare except, no wall clocks in traced code)
+    with a checked-in allowlist for vetted exceptions.
+
+Gate: ``python -m lightgbm_tpu.analysis --json report.json`` exits
+non-zero on any finding; the report validates against
+``analysis/schema.json`` (same contract style as
+``observability/schema.json``).  See README "Static analysis".
+
+This module stays import-light (no jax at import time) so the AST passes
+run anywhere.
+"""
+
+from .common import (Finding, apply_allowlist, build_report, is_allowed,
+                     load_allowlist, load_budgets, load_schema,
+                     validate_findings_report)
+
+__all__ = ["Finding", "apply_allowlist", "build_report", "is_allowed",
+           "load_allowlist", "load_budgets", "load_schema",
+           "validate_findings_report"]

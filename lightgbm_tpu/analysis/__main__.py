@@ -1,0 +1,170 @@
+"""The analysis gate: ``python -m lightgbm_tpu.analysis [--json out.json]``.
+
+Runs the four passes (lint, races, jaxpr, recompile), prints a summary,
+optionally writes the schema-validated JSON findings report, and exits
+non-zero when any unsuppressed finding remains — so it can run as a
+pre-merge check.
+
+``--dump-budgets`` re-derives ``budgets.json`` from the currently traced
+programs (run it when a reviewed learner change legitimately moves a
+collective count, and commit the diff).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List
+
+from . import jaxpr_lint, lint, races, recompile
+from .common import (BUDGETS_PATH, Finding, build_report,
+                     validate_findings_report)
+
+ALL_PASSES = ("lint", "races", "jaxpr", "recompile")
+
+
+def _ensure_cpu_platform() -> None:
+    """Force the 8-virtual-device CPU platform BEFORE the jax backend
+    initializes (mirrors tests/conftest.py: the environment may pin a
+    remote TPU platform)."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = \
+            (flags + " --xla_force_host_platform_device_count=8").strip()
+    import jax
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except RuntimeError:
+        pass                    # backend already initialized (library use)
+
+
+def _environment() -> Dict[str, object]:
+    import jax
+    return {"platform": jax.devices()[0].platform,
+            "device_count": len(jax.devices()),
+            "x64_enabled": bool(jax.config.jax_enable_x64),
+            "jax_version": jax.__version__}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m lightgbm_tpu.analysis",
+        description="Static program-invariant analysis gate")
+    ap.add_argument("--json", metavar="PATH", default="",
+                    help="write the schema-validated findings report here")
+    ap.add_argument("--passes", default=",".join(ALL_PASSES),
+                    help="comma list from {lint,races,jaxpr,recompile}")
+    ap.add_argument("--dump-budgets", metavar="PATH", nargs="?",
+                    const=BUDGETS_PATH, default="",
+                    help="trace the program set and (re)write budgets.json "
+                         "instead of gating")
+    ap.add_argument("--quiet", action="store_true")
+    args = ap.parse_args(argv)
+
+    selected = [p.strip() for p in args.passes.split(",") if p.strip()]
+    unknown = [p for p in selected if p not in ALL_PASSES]
+    if unknown:
+        ap.error(f"unknown pass(es): {unknown}; choose from {ALL_PASSES}")
+
+    def log(msg: str) -> None:
+        if not args.quiet:
+            print(f"[lightgbm_tpu.analysis] {msg}", flush=True)
+
+    if args.dump_budgets or "jaxpr" in selected or "recompile" in selected:
+        _ensure_cpu_platform()
+
+    if args.dump_budgets:
+        log("tracing the program set to derive budgets ...")
+        _, stats, skipped = jaxpr_lint.run(budgets={"max_const_bytes": 0,
+                                                    "programs": {}})
+        if skipped:
+            log(f"WARNING: programs not traced on this platform: "
+                f"{sorted(skipped)} — budgets incomplete")
+            return 1
+        payload = jaxpr_lint.budgets_from_stats(stats)
+        with open(args.dump_budgets, "w") as fh:
+            json.dump(payload, fh, indent=2)
+            fh.write("\n")
+        log(f"wrote {args.dump_budgets}")
+        for name, st in sorted(stats.items()):
+            log(f"  {name}: collectives={st['collectives']} "
+                f"const_bytes={st['const_bytes']}")
+        return 0
+
+    findings: List[Finding] = []
+    pass_results: Dict[str, Dict[str, object]] = {}
+
+    if "lint" in selected:
+        log("pass 1/4: AST repo lint ...")
+        kept, suppressed = lint.run()
+        findings.extend(kept)
+        pass_results["lint"] = {
+            "status": "findings" if kept else "ok",
+            "findings": len(kept), "suppressed": len(suppressed)}
+
+    if "races" in selected:
+        log("pass 2/4: lock-order race detector ...")
+        kept, suppressed = races.run()
+        findings.extend(kept)
+        pass_results["races"] = {
+            "status": "findings" if kept else "ok",
+            "findings": len(kept), "suppressed": len(suppressed)}
+
+    if "jaxpr" in selected:
+        log("pass 3/4: traced-program lints (this traces the tree "
+            "programs; no compilation) ...")
+        fs, stats, skipped = jaxpr_lint.run()
+        findings.extend(fs)
+        pass_results["jaxpr"] = {
+            "status": "findings" if fs else "ok",
+            "findings": len(fs),
+            "programs": {name: {"collectives": st["collectives"],
+                                "const_bytes": st["const_bytes"],
+                                "eqns": st["eqns"]}
+                         for name, st in stats.items()},
+            "detail": ("skipped: " + "; ".join(
+                f"{k} ({v})" for k, v in sorted(skipped.items()))
+                if skipped else "all programs traced")}
+
+    if "recompile" in selected:
+        log("pass 4/4: recompile sentinel (compiles and runs a tiny "
+            "train + serving warm path) ...")
+        fs, detail, skip_reason = recompile.run()
+        findings.extend(fs)
+        pass_results["recompile"] = {
+            "status": ("skipped" if skip_reason
+                       else "findings" if fs else "ok"),
+            "findings": len(fs),
+            "programs": detail,
+            **({"detail": skip_reason} if skip_reason else {})}
+
+    report = build_report(pass_results, findings,
+                          environment=_environment()
+                          if ("jaxpr" in selected or
+                              "recompile" in selected) else None)
+    errs = validate_findings_report(report)
+    if errs:
+        log("INTERNAL: findings report violates analysis/schema.json: "
+            + "; ".join(errs[:5]))
+        return 2
+
+    if args.json:
+        with open(args.json + ".tmp", "w") as fh:
+            json.dump(report, fh, indent=2, sort_keys=True)
+            fh.write("\n")
+        os.replace(args.json + ".tmp", args.json)
+        log(f"report written to {args.json}")
+
+    for f in findings:
+        print(f"FINDING: {f}", flush=True)
+    total = len(findings)
+    statuses = ", ".join(f"{k}={v['status']}"
+                         for k, v in pass_results.items())
+    log(f"{total} finding(s) [{statuses}]")
+    return 1 if total else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

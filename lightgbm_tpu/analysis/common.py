@@ -1,0 +1,147 @@
+"""Shared findings plumbing for the static-analysis passes.
+
+Every pass (`jaxpr_lint` / `recompile` / `races` / `lint`) reports
+violations as ``Finding`` rows; the gate (`python -m lightgbm_tpu.analysis`)
+assembles them into one JSON report validated against the checked-in
+``schema.json`` — the same schema-subset contract the telemetry report uses
+(`observability/schema.json`, validated by the same dependency-free
+validator).
+
+Vetted exceptions live in ``allowlist.json``: one entry per suppressed
+finding, matched on (rule, file suffix, optional symbol), each carrying a
+human-readable reason.  A finding the allowlist matches is counted as
+``suppressed`` in the report, never silently dropped.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+SCHEMA_VERSION = 1
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+SCHEMA_PATH = os.path.join(_HERE, "schema.json")
+ALLOWLIST_PATH = os.path.join(_HERE, "allowlist.json")
+BUDGETS_PATH = os.path.join(_HERE, "budgets.json")
+
+#: the package under analysis (lightgbm_tpu/) and the repo root above it
+PKG_ROOT = os.path.dirname(_HERE)
+REPO_ROOT = os.path.dirname(PKG_ROOT)
+
+
+@dataclass
+class Finding:
+    """One violation.  ``file`` is repo-relative with forward slashes;
+    ``symbol`` is the qualified function/class (or program name for the
+    traced-program passes) the finding anchors to."""
+
+    pass_name: str          # "lint" | "races" | "jaxpr" | "recompile"
+    rule: str               # e.g. "LGB001-socket-timeout", "lock-order-cycle"
+    file: str
+    message: str
+    line: int = 0
+    symbol: Optional[str] = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"pass": self.pass_name, "rule": self.rule, "file": self.file,
+                "line": int(self.line), "symbol": self.symbol,
+                "message": self.message}
+
+    def __str__(self) -> str:
+        loc = f"{self.file}:{self.line}" if self.line else self.file
+        sym = f" [{self.symbol}]" if self.symbol else ""
+        return f"{self.rule} {loc}{sym}: {self.message}"
+
+
+def rel_file(path: str) -> str:
+    """Repo-relative, forward-slash path for findings/allowlist matching."""
+    p = os.path.abspath(path)
+    try:
+        p = os.path.relpath(p, REPO_ROOT)
+    except ValueError:
+        pass
+    return p.replace(os.sep, "/")
+
+
+def _load_json(path: str):
+    with open(path) as fh:
+        return json.load(fh)
+
+
+def load_schema() -> Dict[str, Any]:
+    return _load_json(SCHEMA_PATH)
+
+
+def load_allowlist(path: Optional[str] = None) -> List[Dict[str, Any]]:
+    p = ALLOWLIST_PATH if path is None else path
+    if not os.path.exists(p):
+        return []
+    data = _load_json(p)
+    return list(data.get("allow", []))
+
+
+def load_budgets(path: Optional[str] = None) -> Dict[str, Any]:
+    p = BUDGETS_PATH if path is None else path
+    if not os.path.exists(p):
+        return {"max_const_bytes": 0, "programs": {}}
+    return _load_json(p)
+
+
+def is_allowed(finding: Finding, allowlist: Sequence[Dict[str, Any]]) -> bool:
+    """True when an allowlist entry vouches for this finding.  An entry
+    matches on exact rule, file suffix, and — when it names one — exact
+    symbol; the ``reason`` field is documentation, not matching input."""
+    for entry in allowlist:
+        if entry.get("rule") != finding.rule:
+            continue
+        f = entry.get("file", "")
+        if not f or not finding.file.endswith(f):
+            continue
+        sym = entry.get("symbol")
+        if sym is not None and sym != finding.symbol:
+            continue
+        return True
+    return False
+
+
+def apply_allowlist(findings: Sequence[Finding],
+                    allowlist: Sequence[Dict[str, Any]]
+                    ) -> Tuple[List[Finding], List[Finding]]:
+    """Split into (kept, suppressed)."""
+    kept: List[Finding] = []
+    suppressed: List[Finding] = []
+    for f in findings:
+        (suppressed if is_allowed(f, allowlist) else kept).append(f)
+    return kept, suppressed
+
+
+def build_report(pass_results: Dict[str, Dict[str, Any]],
+                 findings: Sequence[Finding],
+                 environment: Optional[Dict[str, Any]] = None
+                 ) -> Dict[str, Any]:
+    """Assemble the gate's JSON report.  ``pass_results`` maps pass name to
+    ``{"status": ..., "findings": n, ...extras}``."""
+    by_pass: Dict[str, int] = {}
+    for f in findings:
+        by_pass[f.pass_name] = by_pass.get(f.pass_name, 0) + 1
+    env = dict(environment or {})
+    env.setdefault("platform", "unknown")
+    env.setdefault("device_count", 0)
+    env.setdefault("x64_enabled", False)
+    return {
+        "schema_version": SCHEMA_VERSION,
+        "environment": env,
+        "passes": {name: dict(res) for name, res in pass_results.items()},
+        "findings": [f.to_dict() for f in findings],
+        "summary": {"total": len(findings), "by_pass": by_pass},
+    }
+
+
+def validate_findings_report(report: Any) -> List[str]:
+    """Violation strings (empty = valid), via the same JSON-Schema-subset
+    validator the telemetry report uses."""
+    from ..observability.report import validate_report
+    return validate_report(report, load_schema())

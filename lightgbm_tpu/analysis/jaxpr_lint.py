@@ -1,0 +1,327 @@
+"""Traced-program lints: walk closed jaxprs and enforce budgets.
+
+The training and serving hot paths are a handful of jitted programs; the
+regressions that hurt are *structural* and visible at trace time, long
+before a device profile:
+
+  * a new collective slipping into the wave body multiplies per-tree
+    exchanges (the PR-1 class: K psums per stall event instead of one);
+  * an f64 op leaking into a traced path while x64 is off means an
+    unintended cast chain (and on TPU, an emulated-precision cliff);
+  * a ``pure_callback`` / infeed / outfeed in the hot loop is a host sync
+    per iteration;
+  * a large array baked into the program as a constant (instead of passed
+    as an argument) bloats every executable and defeats donation.
+
+``run()`` traces the standard program set — the serial wave tree step
+(`learner_wave.py`), the sharded learners (`parallel/`), and the serving
+binner + traversal programs — and checks each against the checked-in
+per-program budgets (``budgets.json``).  Budgets count **static collective
+call sites** in the traced program (the same notion
+`observability.CollectiveLedger` records): a site inside ``lax.while_loop``
+executes once per iteration, so site count is the per-tree multiplier that
+matters.  Any learner change that adds a collective site must raise the
+budget explicitly in the same commit.
+
+The f64 rule only runs when x64 is off (the gate's configuration); the
+test suite runs with x64 on for parity tests, where f64 is legitimate.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
+
+from .common import Finding, load_budgets
+
+#: jaxpr primitive names that are cross-device collectives
+COLLECTIVE_PRIMS = frozenset({
+    "psum", "pmax", "pmin", "ppermute", "pbroadcast", "all_gather",
+    "all_to_all", "reduce_scatter", "psum_scatter", "pargmax", "pargmin",
+})
+
+#: primitive-name substrings that mean a host round-trip inside the program
+BANNED_SUBSTRINGS = ("callback", "infeed", "outfeed")
+
+#: program name -> the source file a finding anchors to
+PROGRAM_FILES = {
+    "wave_serial": "lightgbm_tpu/learner_wave.py",
+    "wave_sharded_data": "lightgbm_tpu/parallel/wave_sharded.py",
+    "wave_sharded_voting": "lightgbm_tpu/parallel/wave_sharded.py",
+    "wave_feature": "lightgbm_tpu/parallel/feature_sharded.py",
+    "serving_bin": "lightgbm_tpu/serving/binner.py",
+    "serving_traverse": "lightgbm_tpu/predictor.py",
+}
+
+
+def iter_eqns(jaxpr) -> Iterable[Any]:
+    """Every eqn, recursing into sub-jaxprs (pjit / while / cond / scan /
+    shard_map bodies)."""
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for v in eqn.params.values():
+            vs = v if isinstance(v, (list, tuple)) else [v]
+            for s in vs:
+                inner = getattr(s, "jaxpr", None)
+                if inner is not None and hasattr(inner, "eqns"):
+                    yield from iter_eqns(inner)
+                elif hasattr(s, "eqns"):
+                    yield from iter_eqns(s)
+
+
+def collect_stats(closed_jaxpr) -> Dict[str, Any]:
+    """Structural stats of one closed jaxpr: eqn count, per-primitive
+    collective site counts, banned-primitive sites, f64 op count, and the
+    total bytes of baked-in constants."""
+    import numpy as np
+
+    collectives: Dict[str, int] = {}
+    banned: List[str] = []
+    f64_ops = 0
+    eqns = 0
+    for eqn in iter_eqns(closed_jaxpr.jaxpr):
+        eqns += 1
+        name = eqn.primitive.name
+        if name in COLLECTIVE_PRIMS:
+            collectives[name] = collectives.get(name, 0) + 1
+        if any(b in name for b in BANNED_SUBSTRINGS):
+            banned.append(name)
+        for ov in eqn.outvars:
+            dt = getattr(getattr(ov, "aval", None), "dtype", None)
+            if dt is not None and dt == np.dtype("float64"):
+                f64_ops += 1
+                break
+    const_bytes = sum(int(getattr(c, "nbytes", 0))
+                      for c in closed_jaxpr.consts)
+    return {"eqns": eqns, "collectives": collectives, "banned": banned,
+            "f64_ops": f64_ops, "const_bytes": const_bytes}
+
+
+def lint_program(name: str, closed_jaxpr, budget: Dict[str, Any],
+                 max_const_bytes: int, x64_off: bool,
+                 file: Optional[str] = None
+                 ) -> Tuple[List[Finding], Dict[str, Any]]:
+    """Findings for one traced program against its budget entry."""
+    stats = collect_stats(closed_jaxpr)
+    file = file or PROGRAM_FILES.get(name, "lightgbm_tpu")
+    allowed: Dict[str, int] = dict(budget.get("collectives", {}))
+    findings: List[Finding] = []
+    for prim, count in sorted(stats["collectives"].items()):
+        cap = int(allowed.get(prim, 0))
+        if count > cap:
+            findings.append(Finding(
+                "jaxpr", "collective-budget", file,
+                f"program {name!r} traces {count} {prim} site(s), budget "
+                f"allows {cap} — a new collective must raise "
+                f"analysis/budgets.json explicitly", symbol=name))
+    for prim in stats["banned"]:
+        findings.append(Finding(
+            "jaxpr", "host-callback", file,
+            f"program {name!r} contains host-sync primitive {prim!r} "
+            f"inside the traced hot path", symbol=name))
+    if x64_off and stats["f64_ops"]:
+        findings.append(Finding(
+            "jaxpr", "f64-leak", file,
+            f"program {name!r} traces {stats['f64_ops']} float64 op(s) "
+            f"with x64 disabled — an unintended f64 cast chain",
+            symbol=name))
+    cap = int(budget.get("max_const_bytes", max_const_bytes))
+    if cap and stats["const_bytes"] > cap:
+        findings.append(Finding(
+            "jaxpr", "baked-constants", file,
+            f"program {name!r} bakes {stats['const_bytes']} bytes of "
+            f"constants into the trace (ceiling {cap}) — pass large "
+            f"arrays as arguments", symbol=name))
+    return findings, stats
+
+
+# -- the standard program set ------------------------------------------------
+
+def _toy_dataset(n: int, f: int, params: Dict[str, Any]):
+    """Deterministic synthetic problem (seeded Generator — rule LGB003)."""
+    import numpy as np
+
+    import lightgbm_tpu as lgb
+
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((n, f))
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(float)
+    ds = lgb.Dataset(X, label=y, params=params)
+    ds.construct()
+    return ds
+
+
+_BASE_PARAMS = {"objective": "binary", "num_leaves": 15,
+                "min_data_in_leaf": 5, "verbosity": -1}
+
+
+def _trace_wave_serial():
+    import jax
+    import jax.numpy as jnp
+
+    from ..config import Config
+    from ..learner_wave import WaveTPUTreeLearner
+
+    ds = _toy_dataset(512, 4, dict(_BASE_PARAMS))
+    learner = WaveTPUTreeLearner(Config.from_params(_BASE_PARAMS),
+                                 ds.constructed)
+    z = jnp.zeros(ds.constructed.num_data_padded, jnp.float32)
+    fmask = jnp.ones(learner.num_features, bool)
+    return jax.make_jaxpr(learner._train_tree_wave)(
+        learner.bins_packed(), z, z, z, fmask)
+
+
+def _trace_wave_sharded(kind: str):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from ..config import Config
+    from ..parallel.compact_sharded import shard_map
+    from ..parallel.mesh import make_mesh
+    from ..parallel.feature_sharded import FeatureShardedWaveLearner
+    from ..parallel.wave_sharded import ShardedVotingWaveLearner, \
+        ShardedWaveLearner
+
+    params = dict(_BASE_PARAMS, enable_bundle=False)
+    ds = _toy_dataset(2048, 8, params)
+    mesh = make_mesh(2)
+    cfg = Config.from_params(dict(params, tree_learner={
+        "data": "data", "voting": "voting", "feature": "feature"}[kind]))
+    if kind == "feature":
+        learner = FeatureShardedWaveLearner(cfg, ds.constructed, mesh)
+        body = learner._train_tree_feature_wave
+        in_specs = (P(None, None), P(), P(), P(), P())
+        out_specs = (P(), P(), P(), P(), P())
+    else:
+        cls = ShardedWaveLearner if kind == "data" else \
+            ShardedVotingWaveLearner
+        learner = cls(cfg, ds.constructed, mesh)
+        body = learner._train_tree_wave_sharded
+        ax = learner.axis
+        in_specs = (P(None, ax), P(ax), P(ax), P(ax), P())
+        out_specs = (P(), P(), P(), P(ax), P())
+    kw = dict(mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+    try:
+        fn = shard_map(body, check_vma=False, **kw)
+    except TypeError:
+        fn = shard_map(body, check_rep=False, **kw)
+    z = jnp.zeros(learner.n_pad, jnp.float32)
+    fmask_pad = jnp.ones(learner.f_pad, bool)
+    return jax.make_jaxpr(fn)(learner.sharded_bins(), z, z, z, fmask_pad)
+
+
+def _trace_serving_bin():
+    import jax
+    import numpy as np
+
+    from ..serving.binner import BinnerArrays
+
+    ds = _toy_dataset(512, 4, dict(_BASE_PARAMS))
+    arrays = BinnerArrays.for_data(ds.constructed)
+    xu = np.zeros((64, max(arrays.num_used, 1)), np.float64)
+    return jax.make_jaxpr(arrays.bin_device)(xu)
+
+
+def _trace_serving_traverse():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..predictor import _predict_all
+
+    # shape-realistic fake packs: the traversal's structure (and therefore
+    # its collective/callback/f64 profile) depends only on shapes
+    T, ni, nl, F = 6, 14, 15, 8
+    rng = np.random.default_rng(0)
+    packs = dict(
+        feat=jnp.asarray(rng.integers(0, F, (T, ni)), jnp.int32),
+        thr=jnp.asarray(rng.integers(0, 16, (T, ni)), jnp.int32),
+        dtyp=jnp.zeros((T, ni), jnp.int32),
+        lch=jnp.full((T, ni), -1, jnp.int32),
+        rch=jnp.full((T, ni), -1, jnp.int32),
+        lval=jnp.zeros((T, nl), jnp.float32),
+        cat_bits=jnp.zeros((T, 1), jnp.uint32),
+        cat_lo=jnp.zeros((T, ni), jnp.int32),
+        cat_hi=jnp.zeros((T, ni), jnp.int32),
+        cls=jnp.zeros(T, jnp.int32))
+    meta = jnp.zeros(F, jnp.int32)
+    bins = jnp.zeros((F, 64), jnp.int32)
+    fn = functools.partial(_predict_all, depth=4, K=1, es=False,
+                           es_freq=10, es_margin=10.0)
+    return jax.make_jaxpr(fn)(bins, packs, meta, meta, meta)
+
+
+def program_builders(need_mesh_of: int = 2
+                     ) -> Dict[str, Callable[[], Any]]:
+    """Name -> zero-arg tracer for the standard program set.  Sharded
+    programs are included only when the platform exposes enough devices
+    (the gate forces an 8-virtual-device CPU platform)."""
+    import jax
+
+    builders: Dict[str, Callable[[], Any]] = {
+        "wave_serial": _trace_wave_serial,
+        "serving_bin": _trace_serving_bin,
+        "serving_traverse": _trace_serving_traverse,
+    }
+    if len(jax.devices()) >= need_mesh_of:
+        builders["wave_sharded_data"] = lambda: _trace_wave_sharded("data")
+        builders["wave_sharded_voting"] = \
+            lambda: _trace_wave_sharded("voting")
+        builders["wave_feature"] = lambda: _trace_wave_sharded("feature")
+    return builders
+
+
+def run(budgets: Optional[Dict[str, Any]] = None,
+        programs: Optional[Dict[str, Callable[[], Any]]] = None,
+        x64_off: Optional[bool] = None):
+    """Trace the standard program set and lint each against its budget.
+
+    Returns ``(findings, program_stats, skipped)`` where ``program_stats``
+    maps program name to its :func:`collect_stats` output (the input for
+    ``--dump-budgets``) and ``skipped`` maps missing programs to reasons.
+    """
+    import jax
+
+    if budgets is None:
+        budgets = load_budgets()
+    if programs is None:
+        programs = program_builders()
+    if x64_off is None:
+        x64_off = not jax.config.jax_enable_x64
+    max_const = int(budgets.get("max_const_bytes", 0))
+    prog_budgets = budgets.get("programs", {})
+
+    findings: List[Finding] = []
+    stats: Dict[str, Dict[str, Any]] = {}
+    skipped: Dict[str, str] = {}
+    for name in sorted(PROGRAM_FILES):
+        builder = programs.get(name)
+        if builder is None:
+            skipped[name] = "not traceable on this platform " \
+                "(needs a multi-device mesh)"
+            continue
+        closed = builder()
+        fs, st = lint_program(name, closed, prog_budgets.get(name, {}),
+                              max_const, x64_off)
+        findings.extend(fs)
+        stats[name] = st
+    return findings, stats, skipped
+
+
+def budgets_from_stats(stats: Dict[str, Dict[str, Any]],
+                       max_const_bytes: int = 1 << 20) -> Dict[str, Any]:
+    """A budgets.json payload pinning the CURRENT collective site counts
+    (``--dump-budgets``).  Raising a number is a deliberate, reviewed act."""
+    return {
+        "_comment": "Per-program collective-site budgets derived from the "
+                    "traced programs. A learner change that adds a "
+                    "collective site MUST raise its budget here, in the "
+                    "same commit, with the why in the commit message.",
+        "max_const_bytes": int(max_const_bytes),
+        "programs": {
+            name: {"collectives": dict(sorted(
+                st["collectives"].items()))}
+            for name, st in sorted(stats.items())
+        },
+    }

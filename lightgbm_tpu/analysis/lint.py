@@ -1,0 +1,316 @@
+"""AST repo lint: repo-specific invariants checked at analysis time.
+
+The reference C++ tree leans on compiler diagnostics and clang-tidy to keep
+its network and IO layers honest; this is the Python/JAX equivalent, tuned
+to the failure classes PRs 1-4 fixed by hand.  Rules:
+
+  * **LGB001-socket-timeout** — every socket this package creates
+    (``socket.socket`` / ``socket.create_connection`` / ``accept()``) must
+    carry a timeout: either a ``timeout=`` argument at the call or a
+    ``settimeout`` on the result within the same function.  A blocking
+    socket with no deadline is how a dead peer becomes a silent 120 s hang
+    (the PR-4 class).
+  * **LGB002-atomic-write** — a function that opens a file for writing must
+    either go through the temp-file idiom (``tempfile.mkstemp`` in scope)
+    or publish with ``os.replace``; a plain ``open(path, "w")`` leaves a
+    truncated file behind on preemption (the snapshot/model-write class).
+    Vetted streaming writers are allowlisted.
+  * **LGB003-global-np-random** — no ``np.random.<fn>()`` through the
+    global generator; only seeded ``RandomState`` / ``default_rng``
+    instances keep runs reproducible across processes.
+  * **LGB004-bare-except** — no bare ``except:``, and no
+    ``except BaseException`` handler that fails to re-raise: swallowing
+    ``KeyboardInterrupt`` / ``SystemExit`` turns an operator abort into a
+    wedged thread.  Thread-boundary handlers that surface the error
+    elsewhere are allowlisted with the reason.
+  * **LGB005-wallclock-in-traced** — no ``time.time()`` (or monotonic /
+    perf_counter) in modules whose functions are traced into XLA programs:
+    a wall clock read at trace time bakes a constant into the compiled
+    program, silently wrong on every later call.
+
+All rules are heuristic AST checks scoped to one function at a time; the
+checked-in ``allowlist.json`` records every vetted exception with a reason.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from .common import Finding, PKG_ROOT, apply_allowlist, load_allowlist, \
+    rel_file
+
+# modules whose function bodies are traced into XLA programs (wall-clock
+# reads there are trace-time constants, rule LGB005)
+TRACED_DIRS = ("ops", "parallel")
+TRACED_FILES = ("learner.py", "learner_compact.py", "learner_wave.py",
+                "predictor.py", os.path.join("serving", "binner.py"))
+
+# the np.random attributes that ARE the seeded-generator surface
+_SAFE_NP_RANDOM = {"RandomState", "default_rng", "Generator", "SeedSequence",
+                   "PCG64", "Philox", "MT19937", "BitGenerator"}
+
+_WALLCLOCK_FNS = {"time", "monotonic", "perf_counter", "process_time"}
+
+_WRITE_MODES = ("w", "a", "x")
+
+
+def iter_package_files(root: Optional[str] = None) -> Iterable[str]:
+    root = PKG_ROOT if root is None else root
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in sorted(dirnames) if d != "__pycache__"]
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                yield os.path.join(dirpath, fn)
+
+
+def is_traced_module(path: str) -> bool:
+    rel = os.path.relpath(os.path.abspath(path), PKG_ROOT)
+    parts = rel.split(os.sep)
+    return parts[0] in TRACED_DIRS or rel in TRACED_FILES
+
+
+# -- scope walking -----------------------------------------------------------
+
+class _Scope:
+    """One function (or the module body) — the unit every rule reasons
+    over."""
+
+    def __init__(self, node: ast.AST, qualname: str):
+        self.node = node
+        self.qualname = qualname
+        self.socket_calls: List[Tuple[ast.Call, str, Optional[str]]] = []
+        self.settimeout_targets: Set[str] = set()
+        self.open_calls: List[ast.Call] = []
+        self.has_replace = False
+        self.has_mkstemp = False
+
+
+def _call_name(call: ast.Call) -> str:
+    """Dotted name of the called expression ('' when not a plain chain)."""
+    try:
+        return ast.unparse(call.func)
+    except Exception:
+        return ""
+
+
+def _assign_target_for(call: ast.Call, scope_node: ast.AST) -> Optional[str]:
+    """The (unparsed) variable the call's result lands in, following one
+    level of tuple unpack (``conn, addr = srv.accept()`` -> ``conn``)."""
+    for node in ast.walk(scope_node):
+        if isinstance(node, ast.Assign) and node.value is call:
+            tgt = node.targets[0]
+            if isinstance(tgt, ast.Tuple) and tgt.elts:
+                tgt = tgt.elts[0]
+            try:
+                return ast.unparse(tgt)
+            except Exception:
+                return None
+        if isinstance(node, ast.withitem) and node.context_expr is call:
+            if node.optional_vars is not None:
+                try:
+                    return ast.unparse(node.optional_vars)
+                except Exception:
+                    return None
+    return None
+
+
+def _collect_scopes(tree: ast.Module) -> List[_Scope]:
+    scopes: List[_Scope] = [_Scope(tree, "<module>")]
+
+    def visit(node: ast.AST, stack: List[str]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scopes.append(_Scope(child, ".".join(stack + [child.name])))
+                visit(child, stack + [child.name])
+            elif isinstance(child, ast.ClassDef):
+                visit(child, stack + [child.name])
+            else:
+                visit(child, stack)
+
+    visit(tree, [])
+    return scopes
+
+
+def _own_nodes(scope: _Scope, all_scopes: List[_Scope]) -> Iterable[ast.AST]:
+    """Nodes belonging to this scope, excluding nested function bodies."""
+    nested = {id(s.node) for s in all_scopes if s.node is not scope.node}
+
+    def walk(node: ast.AST):
+        for child in ast.iter_child_nodes(node):
+            if id(child) in nested:
+                continue
+            yield child
+            yield from walk(child)
+
+    yield from walk(scope.node)
+
+
+# -- the rules ---------------------------------------------------------------
+
+def _scan_scope(scope: _Scope, all_scopes: List[_Scope]) -> None:
+    for node in _own_nodes(scope, all_scopes):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _call_name(node)
+        if name in ("socket.socket",):
+            scope.socket_calls.append((node, "socket.socket",
+                                       _assign_target_for(node, scope.node)))
+        elif name in ("socket.create_connection",):
+            scope.socket_calls.append((node, "socket.create_connection",
+                                       _assign_target_for(node, scope.node)))
+        elif name.endswith(".accept") and isinstance(node.func,
+                                                     ast.Attribute):
+            scope.socket_calls.append((node, "accept",
+                                       _assign_target_for(node, scope.node)))
+        elif name.endswith(".settimeout") and isinstance(node.func,
+                                                         ast.Attribute):
+            try:
+                scope.settimeout_targets.add(ast.unparse(node.func.value))
+            except Exception:
+                pass
+        elif name in ("os.replace",):
+            scope.has_replace = True
+        elif name in ("tempfile.mkstemp", "tempfile.NamedTemporaryFile",
+                      "tempfile.TemporaryFile"):
+            scope.has_mkstemp = True
+        if _is_write_open(node, name):
+            scope.open_calls.append(node)
+
+
+def _is_write_open(call: ast.Call, name: str) -> bool:
+    if not (name == "open" or name.endswith(".open")
+            or name.endswith(".fdopen")):
+        return False
+    mode = None
+    if len(call.args) >= 2 and isinstance(call.args[1], ast.Constant):
+        mode = call.args[1].value
+    for kw in call.keywords:
+        if kw.arg == "mode" and isinstance(kw.value, ast.Constant):
+            mode = kw.value.value
+    return isinstance(mode, str) and mode.startswith(_WRITE_MODES)
+
+
+def _has_timeout_kwarg(call: ast.Call) -> bool:
+    return any(kw.arg == "timeout" for kw in call.keywords)
+
+
+def _handler_reraises(handler: ast.ExceptHandler) -> bool:
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise) and node.exc is None:
+            return True
+    return False
+
+
+def _names_base_exception(expr: Optional[ast.expr]) -> bool:
+    if expr is None:
+        return False
+    exprs = expr.elts if isinstance(expr, ast.Tuple) else [expr]
+    for e in exprs:
+        if isinstance(e, ast.Name) and e.id == "BaseException":
+            return True
+        if isinstance(e, ast.Attribute) and e.attr == "BaseException":
+            return True
+    return False
+
+
+def lint_file(path: str, traced: Optional[bool] = None) -> List[Finding]:
+    """All rule findings for one file (no allowlist applied)."""
+    with open(path) as fh:
+        src = fh.read()
+    tree = ast.parse(src, filename=path)
+    rf = rel_file(path)
+    traced = is_traced_module(path) if traced is None else traced
+    findings: List[Finding] = []
+
+    scopes = _collect_scopes(tree)
+    for scope in scopes:
+        _scan_scope(scope, scopes)
+
+        # LGB001: sockets must carry timeouts
+        for call, kind, target in scope.socket_calls:
+            if _has_timeout_kwarg(call):
+                continue
+            if target is not None and target in scope.settimeout_targets:
+                continue
+            findings.append(Finding(
+                "lint", "LGB001-socket-timeout", rf,
+                f"{kind} result "
+                f"{'(' + target + ') ' if target else ''}has no timeout: "
+                f"pass timeout= or call settimeout() in the same function",
+                line=call.lineno, symbol=scope.qualname))
+
+        # LGB002: durable writes must be atomic
+        if not (scope.has_replace or scope.has_mkstemp):
+            for call in scope.open_calls:
+                findings.append(Finding(
+                    "lint", "LGB002-atomic-write", rf,
+                    "file opened for writing without os.replace or a "
+                    "tempfile in scope — a crash mid-write leaves a "
+                    "truncated file",
+                    line=call.lineno, symbol=scope.qualname))
+
+    for node in ast.walk(tree):
+        # LGB003: global numpy RNG
+        if isinstance(node, ast.Call) and isinstance(node.func,
+                                                     ast.Attribute):
+            f = node.func
+            if isinstance(f.value, ast.Attribute) and \
+                    f.value.attr == "random" and \
+                    isinstance(f.value.value, ast.Name) and \
+                    f.value.value.id in ("np", "numpy") and \
+                    f.attr not in _SAFE_NP_RANDOM:
+                findings.append(Finding(
+                    "lint", "LGB003-global-np-random", rf,
+                    f"np.random.{f.attr}() uses the GLOBAL generator; "
+                    f"use a seeded np.random.default_rng/RandomState",
+                    line=node.lineno))
+
+        # LGB004: bare / swallowing-BaseException handlers
+        if isinstance(node, ast.ExceptHandler):
+            if node.type is None:
+                findings.append(Finding(
+                    "lint", "LGB004-bare-except", rf,
+                    "bare `except:` catches SystemExit/KeyboardInterrupt; "
+                    "name the exception types",
+                    line=node.lineno))
+            elif _names_base_exception(node.type) and \
+                    not _handler_reraises(node):
+                findings.append(Finding(
+                    "lint", "LGB004-bare-except", rf,
+                    "`except BaseException` without re-raise swallows "
+                    "KeyboardInterrupt/SystemExit; catch Exception or "
+                    "re-raise",
+                    line=node.lineno))
+
+        # LGB005: wall clocks in traced modules
+        if traced and isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr in _WALLCLOCK_FNS and \
+                isinstance(node.func.value, ast.Name) and \
+                node.func.value.id in ("time", "_time"):
+            findings.append(Finding(
+                "lint", "LGB005-wallclock-in-traced", rf,
+                f"time.{node.func.attr}() in a traced module bakes a "
+                f"trace-time constant into the compiled program",
+                line=node.lineno))
+
+    return findings
+
+
+def run(paths: Optional[Sequence[str]] = None,
+        allowlist: Optional[Sequence[dict]] = None,
+        traced: Optional[bool] = None):
+    """Run the repo lint.  Returns ``(findings, suppressed)`` after
+    allowlist filtering.  ``paths`` defaults to every module under
+    ``lightgbm_tpu/``; pass ``traced=True`` to force LGB005 on explicit
+    paths (fixture tests)."""
+    if paths is None:
+        paths = list(iter_package_files())
+    if allowlist is None:
+        allowlist = load_allowlist()
+    findings: List[Finding] = []
+    for p in paths:
+        findings.extend(lint_file(p, traced=traced))
+    return apply_allowlist(findings, allowlist)

@@ -1,0 +1,152 @@
+"""Recompile sentinel: fingerprint jit caches, fail on silent retraces.
+
+The serving path's whole latency story rests on "warmed buckets never
+compile" (`serving/batcher.py`), and the training loop's on "one tree
+program per shape signature" — both regressed silently in the past
+(recompiles on every new row count, PR-2 motivation).  The sentinel makes
+that invariant checkable:
+
+  * ``register(name, fn)`` a jitted callable (anything exposing the
+    ``_cache_size()`` introspection jax gives jitted functions);
+  * ``arm()`` after warmup to snapshot every cache's entry count — the
+    fingerprint;
+  * ``check()`` after exercising the steady-state path: any cache that
+    GREW retraced a warmed program and yields a finding.
+
+``run()`` is the gate pass: it trains a tiny booster for two iterations
+(warmup), fingerprints the tree-step jit, trains two more and verifies
+zero retraces; then it warms a ``ServingModel`` over two row buckets,
+fingerprints the binner + traversal jits, replays in-bucket requests of
+several distinct row counts and verifies the request path never compiled —
+the same invariant `tests/test_serving.py::test_zero_recompiles_within_bucket`
+asserts over the socket, enforced here without a server.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from .common import Finding
+
+
+def jit_cache_size(fn: Any) -> Optional[int]:
+    """Entry count of a jitted callable's cache, or None when this jax
+    version does not expose it."""
+    try:
+        return int(fn._cache_size())
+    except Exception:
+        return None
+
+
+class RecompileSentinel:
+    """Snapshot-and-compare over named jit caches."""
+
+    def __init__(self) -> None:
+        self._fns: Dict[str, Tuple[Any, str]] = {}
+        self._snap: Dict[str, Optional[int]] = {}
+
+    def register(self, name: str, fn: Any,
+                 file: str = "lightgbm_tpu") -> None:
+        self._fns[name] = (fn, file)
+
+    def arm(self) -> Dict[str, Optional[int]]:
+        """Fingerprint every registered cache (call after warmup)."""
+        self._snap = {name: jit_cache_size(fn)
+                      for name, (fn, _) in self._fns.items()}
+        return dict(self._snap)
+
+    def deltas(self) -> Dict[str, Tuple[Optional[int], Optional[int]]]:
+        return {name: (self._snap.get(name), jit_cache_size(fn))
+                for name, (fn, _) in self._fns.items()}
+
+    def check(self) -> List[Finding]:
+        """Findings for every program whose cache grew since ``arm()``."""
+        out: List[Finding] = []
+        for name, (fn, file) in self._fns.items():
+            before = self._snap.get(name)
+            after = jit_cache_size(fn)
+            if before is None or after is None:
+                continue
+            if after > before:
+                out.append(Finding(
+                    "recompile", "retrace", file,
+                    f"warmed program {name!r} retraced: jit cache grew "
+                    f"{before} -> {after} entries after warmup",
+                    symbol=name))
+        return out
+
+    def supported(self) -> bool:
+        return any(jit_cache_size(fn) is not None
+                   for fn, _ in self._fns.values())
+
+
+# -- the gate pass -----------------------------------------------------------
+
+def _tiny_booster(n: int = 256, f: int = 4, iters: int = 2):
+    import numpy as np
+
+    import lightgbm_tpu as lgb
+
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((n, f))
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(float)
+    params = {"objective": "binary", "num_leaves": 7, "min_data_in_leaf": 5,
+              "verbosity": -1}
+    ds = lgb.Dataset(X, label=y, params=params)
+    bst = lgb.Booster(params, ds)
+    for _ in range(iters):
+        bst.update()
+    return bst
+
+
+def _learner_jits(learner) -> Dict[str, Any]:
+    out = {}
+    for attr in ("_jit_tree_w", "_jit_tree_c"):
+        fn = getattr(learner, attr, None)
+        if fn is not None and jit_cache_size(fn) is not None:
+            out[f"train_step{attr}"] = fn
+    return out
+
+
+def run() -> Tuple[List[Finding], Dict[str, Any], Optional[str]]:
+    """Gate pass: ``(findings, detail, skip_reason)``.  ``detail`` records
+    the per-program (before, after) cache fingerprints."""
+    import numpy as np
+
+    from ..predictor import _predict_all
+    from ..serving.binner import _bin_device
+    from ..serving.registry import ServingModel
+
+    sentinel = RecompileSentinel()
+
+    # -- training step: two warmup iterations, then two steady-state ones
+    bst = _tiny_booster(iters=2)
+    learner = bst.gbdt.learner
+    jits = _learner_jits(learner)
+    for name, fn in jits.items():
+        sentinel.register(name, fn, "lightgbm_tpu/learner_wave.py")
+
+    # -- serving: warm two buckets, fingerprint, replay in-bucket sizes
+    model = ServingModel(bst)
+    buckets = (32, 64)
+    model.warm(buckets)
+    sentinel.register("serving_bin", _bin_device,
+                      "lightgbm_tpu/serving/binner.py")
+    sentinel.register("serving_traverse", _predict_all,
+                      "lightgbm_tpu/predictor.py")
+    if not sentinel.supported():
+        return [], {}, "jit cache introspection (_cache_size) unavailable " \
+            "on this jax version"
+
+    snap = sentinel.arm()
+    for _ in range(2):
+        bst.update()                         # same shapes: must not retrace
+    for bucket in buckets:
+        for m in (1, bucket // 2, bucket):   # distinct in-bucket row counts
+            Xpad = np.zeros((bucket, model.num_features))
+            model.predict_padded(Xpad, m)
+    findings = sentinel.check()
+    detail = {name: {"before": b, "after": a}
+              for name, (b, a) in sentinel.deltas().items()}
+    detail["armed"] = {k: v for k, v in snap.items()}
+    return findings, detail, None

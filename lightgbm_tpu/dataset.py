@@ -546,11 +546,15 @@ class _ConstructedDataset:
 
     def save_binary(self, filename: str) -> None:
         """Serialize the constructed (binned) dataset — reloading skips
-        find-bin + binning entirely (`dataset.h:394` SaveBinaryFile)."""
+        find-bin + binning entirely (`dataset.h:394` SaveBinaryFile).
+        Atomic (tmp + ``os.replace``): a preempted save never leaves a
+        truncated cache a later run would fail to load."""
         import json
+        import os
 
         md = self.metadata
-        with open(filename, "wb") as fh:  # np.savez appends .npz to names
+        tmp = filename + ".tmp"
+        with open(tmp, "wb") as fh:  # np.savez appends .npz to names
             np.savez_compressed(
                 fh,
                 lgbt_binary_version=np.int64(self.BINARY_VERSION),
@@ -571,6 +575,7 @@ class _ConstructedDataset:
                                   else np.zeros(0, np.int32)),
                 init_score=(md.init_score if md.init_score is not None
                             else np.zeros(0, np.float64)))
+        os.replace(tmp, filename)
 
     @classmethod
     def load_binary(cls, filename: str, cfg: Config) -> "_ConstructedDataset":

@@ -73,11 +73,15 @@ def validate_report(report: Any, schema: Dict[str, Any] = None,
 
 def write_report(report: Dict[str, Any], path: str) -> None:
     """Validate-and-write; a schema violation raises rather than shipping
-    a malformed report for a driver to choke on later."""
+    a malformed report for a driver to choke on later.  The write is
+    atomic (tmp + ``os.replace``) so a crash mid-dump never leaves a
+    truncated report for that driver to trip over."""
     errs = validate_report(report)
     if errs:
         raise ValueError("telemetry report violates schema.json: "
                          + "; ".join(errs[:5]))
-    with open(path, "w") as fh:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
         json.dump(report, fh, indent=2, sort_keys=True)
         fh.write("\n")
+    os.replace(tmp, path)

@@ -161,11 +161,13 @@ class PredictionServer:
                 continue
             except OSError:
                 break
+            # deadline before the handler thread exists: a client that
+            # connects and never speaks can otherwise pin a thread forever
+            conn.settimeout(self.request_timeout + 30.0)
             threading.Thread(target=self._handle, args=(conn,),
                              name="lgbt-serve-conn", daemon=True).start()
 
     def _handle(self, conn: socket.socket) -> None:
-        conn.settimeout(self.request_timeout + 30.0)
         try:
             while not self._stop.is_set():
                 try:
@@ -174,7 +176,10 @@ class PredictionServer:
                     break
                 try:
                     resp = self._dispatch(msg)
-                except BaseException as e:
+                except Exception as e:
+                    # Exception, not BaseException: a SystemExit /
+                    # KeyboardInterrupt must kill the handler, not become
+                    # an RPC error frame
                     resp = {"ok": False, "error": f"{type(e).__name__}: {e}"}
                 try:
                     send_frame(conn, resp)

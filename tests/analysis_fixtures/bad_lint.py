@@ -1,0 +1,54 @@
+"""Deliberately-bad lint fixture for tests/test_analysis.py.
+
+Every repo lint rule (`lightgbm_tpu/analysis/lint.py`) must trip at least
+once on this module.  It is parsed by the AST pass, never imported or
+executed — the code below is intentionally wrong.
+"""
+
+import socket
+import time
+
+import numpy as np
+
+
+def no_timeout_socket(host, port):
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)   # LGB001
+    s.connect((host, port))
+    return s
+
+
+def no_timeout_connect(host, port):
+    return socket.create_connection((host, port))           # LGB001
+
+
+def unguarded_accept(srv):
+    conn, _addr = srv.accept()                              # LGB001
+    return conn
+
+
+def torn_model_write(path, text):
+    with open(path, "w") as fh:                             # LGB002
+        fh.write(text)
+
+
+def global_rng(n):
+    return np.random.rand(n)                                # LGB003
+
+
+def swallow_everything(fn):
+    try:
+        return fn()
+    except:                                                 # LGB004 (bare)
+        return None
+
+
+def swallow_base(fn):
+    try:
+        return fn()
+    except BaseException:                                   # LGB004
+        return None
+
+
+def traced_wallclock(x):
+    # LGB005 when the file is linted as a traced module
+    return x * time.time()

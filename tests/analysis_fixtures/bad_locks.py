@@ -1,0 +1,57 @@
+"""Deliberately-bad lock fixture for tests/test_analysis.py.
+
+The static race pass (`lightgbm_tpu/analysis/races.py`) must find here:
+
+  * a lock-order CYCLE: ``Left.poke`` holds ``Left._lock`` while calling
+    into ``Right.push`` (which takes ``Right._lock``), and ``Right.poke``
+    holds ``Right._lock`` while calling into ``Left.push`` (which takes
+    ``Left._lock``) — the classic ABBA deadlock shape;
+  * a MIXED-MUTATION field: ``Mixed.total`` is incremented under the lock
+    in ``add`` but reset without it in ``sloppy_reset``.
+
+Parsed by the AST pass, never imported or executed.
+"""
+
+import threading
+
+
+class Left:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.right = Right()
+        self.count = 0
+
+    def poke(self):
+        with self._lock:
+            self.right.push()       # holds Left._lock -> takes Right._lock
+
+    def push(self):
+        with self._lock:
+            self.count += 1
+
+
+class Right:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.left = Left()
+
+    def push(self):
+        with self._lock:
+            pass
+
+    def poke(self):
+        with self._lock:
+            self.left.push()        # holds Right._lock -> takes Left._lock
+
+
+class Mixed:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.total = 0
+
+    def add(self, v):
+        with self._lock:
+            self.total += v
+
+    def sloppy_reset(self):
+        self.total = 0              # mutated OUTSIDE the lock

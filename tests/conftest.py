@@ -42,9 +42,10 @@ def rng():
     return np.random.RandomState(42)
 
 
-# per-test timeout for serving- and chaos-marked tests (threads + sockets
-# + injected faults): a hung accept loop, a lost batcher event or an
-# injected network hang must fail ONE test, not stall the tier-1 suite.
+# per-test timeout for serving-, chaos- and analysis-marked tests (threads
+# + sockets + injected faults + subprocess gates): a hung accept loop, a
+# lost batcher event or an injected network hang must fail ONE test, not
+# stall the tier-1 suite.
 # SIGALRM fires in the main thread, which is exactly where the test body
 # blocks; no external pytest-timeout dependency needed.
 import signal  # noqa: E402
@@ -55,7 +56,8 @@ _SERVING_TIMEOUT_S = 120
 @pytest.hookimpl(hookwrapper=True)
 def pytest_runtest_call(item):
     marker = item.get_closest_marker("serving") \
-        or item.get_closest_marker("chaos")
+        or item.get_closest_marker("chaos") \
+        or item.get_closest_marker("analysis")
     if marker is None or not hasattr(signal, "SIGALRM"):
         yield
         return
