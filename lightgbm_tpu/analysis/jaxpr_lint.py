@@ -46,6 +46,11 @@ BANNED_SUBSTRINGS = ("callback", "infeed", "outfeed")
 #: program name -> the source file a finding anchors to
 PROGRAM_FILES = {
     "wave_serial": "lightgbm_tpu/learner_wave.py",
+    # the serial wave program with BOTH round-6 Pallas kernels forced on
+    # (stable partition replacing the re-compaction sort + fused split
+    # scan) — traced in interpret mode off-TPU, which exercises the same
+    # jaxpr structure the TPU path compiles
+    "wave_serial_pallas": "lightgbm_tpu/ops/partition_pallas.py",
     "wave_sharded_data": "lightgbm_tpu/parallel/wave_sharded.py",
     "wave_sharded_voting": "lightgbm_tpu/parallel/wave_sharded.py",
     "wave_feature": "lightgbm_tpu/parallel/feature_sharded.py",
@@ -171,6 +176,29 @@ def _trace_wave_serial():
         learner.bins_packed(), z, z, z, fmask)
 
 
+def _trace_wave_serial_pallas():
+    import jax
+    import jax.numpy as jnp
+
+    from ..config import Config
+    from ..learner_wave import WaveTPUTreeLearner
+
+    ds = _toy_dataset(512, 4, dict(_BASE_PARAMS))
+    cfg = Config.from_params(dict(
+        _BASE_PARAMS, tpu_wave_pallas_partition="on",
+        tpu_wave_pallas_scan="on",
+        # CI-sized windows must clear the sortable cutoff or the
+        # partition cond never traces its kernel branch
+        tpu_wave_sort_cutoff=64, tpu_sort_cutoff=32))
+    learner = WaveTPUTreeLearner(cfg, ds.constructed)
+    assert learner._use_partition and learner._use_scan, \
+        "forced Pallas knobs did not resolve on"
+    z = jnp.zeros(ds.constructed.num_data_padded, jnp.float32)
+    fmask = jnp.ones(learner.num_features, bool)
+    return jax.make_jaxpr(learner._train_tree_wave)(
+        learner.bins_packed(), z, z, z, fmask)
+
+
 def _trace_wave_sharded(kind: str):
     import jax
     import jax.numpy as jnp
@@ -261,6 +289,7 @@ def program_builders(need_mesh_of: int = 2
 
     builders: Dict[str, Callable[[], Any]] = {
         "wave_serial": _trace_wave_serial,
+        "wave_serial_pallas": _trace_wave_serial_pallas,
         "serving_bin": _trace_serving_bin,
         "serving_traverse": _trace_serving_traverse,
     }

@@ -305,14 +305,27 @@ class GBDT:
         self._flush_pending()
         self._models = list(value)
 
-    def _flush_pending(self) -> None:
-        """Assemble host trees for every pipelined iteration dispatched so
+    def _flush_pending(self, keep: int = 0) -> None:
+        """Assemble host trees for pipelined iterations dispatched so
         far, then run the deferred no-more-splits stop check
-        (`gbdt.cpp:379-387` in the sync loop)."""
+        (`gbdt.cpp:379-387` in the sync loop).
+
+        ``keep`` leaves the newest ``keep`` queue entries un-assembled —
+        the cross-iteration pipelining seam: the boosting loop flushes
+        with ``keep = tpu_pipeline_flush_depth`` every iteration, so each
+        step assembles exactly ONE tree whose device program retired many
+        iterations ago (its record copies are host-resident) while the
+        devices keep executing the queued tail.  The round-5 batch flush
+        (keep=0 every 16th iteration) drained the whole queue in one
+        device-idle stall — 15-25 ms/tree of host assembly plus the queue
+        sync, the largest non-device cost in the trace."""
         pend = getattr(self, "_pending", None)
-        if not pend:
+        if not pend or len(pend) <= keep:
             return
-        self._pending = []
+        if keep > 0:
+            pend, self._pending = pend[:-keep], pend[-keep:]
+        else:
+            self._pending = []
         tel = self.telemetry
         _flush_t0 = time.perf_counter() if tel.enabled else 0.0
         # the record arrays were copy_to_host_async'd at dispatch time, so
@@ -322,25 +335,8 @@ class GBDT:
         # the earlier stack+3-fetch flush paid ~0.3 s plus a first-call
         # compile; per-tree cold fetches would cost ~5 s per flush.)
         first_idx = len(self._models)
-        for idx, rf, ri, rc, init_sc in pend:
-            tree = self.learner.assemble_host(np.asarray(rf),
-                                              np.asarray(ri),
-                                              np.asarray(rc))
-            if tree.num_leaves > 1:
-                tree.apply_shrinkage(self.shrinkage_rate)
-                if abs(init_sc) > kEpsilon:
-                    tree.leaf_value[:tree.num_leaves] += init_sc
-                    tree.shrinkage = 1.0
-            elif idx < self.num_tree_per_iteration:
-                # nothing splittable on the very first iteration: keep the
-                # boost-from-average constant model and add its output to the
-                # training score, matching the sync path (`gbdt.cpp:395-404`)
-                tree.leaf_value[0] = init_sc
-                if abs(init_sc) > kEpsilon:
-                    self.train_score.add_constant(init_sc,
-                                                  idx % self.num_tree_per_iteration)
-            self._models[idx] = tree
-            first_idx = min(first_idx, idx)
+        for entry in pend:
+            first_idx = min(first_idx, self._assemble_entry(entry))
         # deferred stop detection over the flushed iterations only: the first
         # iteration in which NO class grew a tree ends training; later
         # iterations repeated the draw and are dropped (`gbdt.cpp:379-387`),
@@ -351,6 +347,13 @@ class GBDT:
             trees = self._models[it * k:(it + 1) * k]
             if trees and all(t is not None and t.num_leaves <= 1
                              for t in trees):
+                # a rolling flush may still hold queued post-stop
+                # iterations whose device score updates already applied —
+                # drain them so the rollback below covers every tree
+                if self._pending:
+                    tail, self._pending = self._pending, []
+                    for entry in tail:
+                        self._assemble_entry(entry)
                 # keep iteration 0's constant trees (the sync path's
                 # first-iteration case keeps them too); everything after the
                 # stop iteration is rolled back and dropped
@@ -374,9 +377,34 @@ class GBDT:
                                time.perf_counter() - _flush_t0)
             tel.inc("pipeline_flushes")
             tel.inc("trees_assembled", len(pend))
-            # the per-tree device counter vectors rode the same async
-            # copies as the records — decode them now, off the hot path
-            tel.flush_device()
+            if keep == 0:
+                # the per-tree device counter vectors rode the same async
+                # copies as the records — decode them now, off the hot
+                # path (a rolling flush keeps queued trees executing, so
+                # their counters are decoded at the next full flush)
+                tel.flush_device()
+
+    def _assemble_entry(self, entry) -> int:
+        """Materialize one queued pipelined tree into ``self._models``;
+        returns its model index."""
+        idx, rf, ri, rc, init_sc = entry
+        tree = self.learner.assemble_host(np.asarray(rf), np.asarray(ri),
+                                          np.asarray(rc))
+        if tree.num_leaves > 1:
+            tree.apply_shrinkage(self.shrinkage_rate)
+            if abs(init_sc) > kEpsilon:
+                tree.leaf_value[:tree.num_leaves] += init_sc
+                tree.shrinkage = 1.0
+        elif idx < self.num_tree_per_iteration:
+            # nothing splittable on the very first iteration: keep the
+            # boost-from-average constant model and add its output to the
+            # training score, matching the sync path (`gbdt.cpp:395-404`)
+            tree.leaf_value[0] = init_sc
+            if abs(init_sc) > kEpsilon:
+                self.train_score.add_constant(
+                    init_sc, idx % self.num_tree_per_iteration)
+        self._models[idx] = tree
+        return idx
 
     # -- GBDT::Init (`gbdt.cpp:45-137`) -------------------------------------
 
@@ -612,7 +640,14 @@ class GBDT:
                               init_scores[0]))
         self._models.append(None)
         self.iter_ += 1
-        if len(self._pending) >= 16:
+        # cross-iteration pipelining: assemble ONE depth-old tree per
+        # iteration (host work overlaps the executing queue) instead of
+        # draining 16 in a device-idle stall; depth <= 0 restores the
+        # round-5 batch flush
+        depth = int(getattr(self.cfg, "tpu_pipeline_flush_depth", 8))
+        if depth > 0:
+            self._flush_pending(keep=depth)
+        elif len(self._pending) >= 16:
             self._flush_pending()
         return self._stopped
 
@@ -654,8 +689,12 @@ class GBDT:
             self._models.append(None)
         self.iter_ += 1
         # bound stop-detection staleness without stalling the pipeline: the
-        # arrays synced here finished many iterations ago
-        if len(self._pending) >= 16 * self.num_tree_per_iteration:
+        # arrays synced here finished many iterations ago (see
+        # _train_trees_fused for the rolling-flush rationale)
+        depth = int(getattr(self.cfg, "tpu_pipeline_flush_depth", 8))
+        if depth > 0:
+            self._flush_pending(keep=depth * self.num_tree_per_iteration)
+        elif len(self._pending) >= 16 * self.num_tree_per_iteration:
             self._flush_pending()
         return self._stopped
 

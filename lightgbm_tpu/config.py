@@ -430,8 +430,49 @@ class Config:
     # growth overshoot is — the replay pops exactly (num_leaves - 1)
     # splits regardless — and the slot/pool sizing already reserves
     # (num_leaves - 1) correction splits, so a guard stops batching near
-    # that reserve.  1 = the round-4 one-miss-per-pass behavior
-    tpu_wave_stall_batch: int = 4
+    # that reserve.  1 = the round-4 one-miss-per-pass behavior;
+    # -1 = auto (currently 4 at every scale — the round-5 sweep winner;
+    # re-sweep {2,3,4,6} rides profiling/profile_stall_batch.py)
+    tpu_wave_stall_batch: int = -1
+    # fuse the batched replay correction's TOP member into the
+    # span-vectorized partition stage whenever its covering span fits the
+    # vec cap: a stall event then runs ONE masked pass (one switch
+    # dispatch) instead of top-switch + extras-switch.  Exact — both
+    # stages share _span_decide; False = the round-5 two-stage flow
+    tpu_wave_stall_fuse_top: bool = True
+    # Pallas stable row-partition kernel (ops/partition_pallas.py): the
+    # wave learner's full-array re-compaction sort becomes a two-pass
+    # stable partition (exact destinations from prefix sums + a chunked
+    # byte-plane permute kernel), the port of the reference's OpenCL
+    # data-partition kernel.  "auto" = on whenever the Pallas histogram
+    # path runs and the shape gates pass (record-exact vs the sort path);
+    # "on" forces it (interpret mode off-TPU — tests); "off" keeps the
+    # round-5 sort flow.  Partition mode disables sort-deferral (each
+    # wave partitions its own windows; a partition pass is cheap enough
+    # that halving pass count no longer pays for the deferred waves'
+    # double-area member histograms)
+    tpu_wave_pallas_partition: str = "auto"
+    # Pallas fused split-scan kernel (ops/scan_pallas.py): the
+    # (leaves x features x bins) best-split search — cumulative
+    # histograms, gain evaluation, validity masks, per-feature argmax —
+    # runs as ONE kernel instead of the XLA scan+argmax chain, the port
+    # of the reference's OpenCL split-scan kernel.  "auto" = on alongside
+    # the Pallas histogram path for plain numerical splits (no monotone
+    # constraints / categorical features / feature penalties); "on"
+    # forces it (interpret off-TPU); "off" = the XLA path
+    tpu_wave_pallas_scan: str = "auto"
+    # pipelined flush depth: a queued iteration's host tree is assembled
+    # once it is this many iterations old (device execution has long
+    # finished), so host assembly overlaps device compute instead of
+    # draining the whole 16-deep queue in one device-idle stall;
+    # 0 = the round-5 batch flush (assemble 16 at once)
+    tpu_pipeline_flush_depth: int = 8
+    # vectorized host tree assembly (learner.assemble_host): one numpy
+    # pass over the record batch instead of ~20 scalar numpy ops per
+    # split (15-25 ms/tree inside every pipeline flush — round-5 trace).
+    # Trees with categorical splits keep the sequential path (bitset
+    # bookkeeping is order-dependent); False = always sequential
+    tpu_vec_assemble: bool = True
 
     # derived (not user-settable)
     is_parallel: bool = field(default=False, repr=False)

@@ -63,6 +63,7 @@ from .observability.telemetry import (TEL_FROZEN_MEMBERS, TEL_GROW_SPLITS,
                                       TEL_STALL_SPLITS, TEL_TOTAL_SPLITS,
                                       TEL_WAVE_MEMBERS, TEL_WAVE_SORTS,
                                       TEL_WAVES)
+from .ops.histogram import _on_tpu
 from .ops.lookup import lookup_int
 
 _HIGH = lax.Precision.HIGHEST
@@ -75,6 +76,17 @@ def _stall_extras_cap(budget: int) -> int:
     return min(budget - 1, 64)
 
 
+def _resolve_stall_batch(cfg: Config) -> int:
+    """``tpu_wave_stall_batch`` with -1 = auto.  Auto is 4 at every
+    measured scale (the round-5 K sweep winner over {1, 8, 16}; the
+    round-6 re-sweep {2, 3, 6} rides profile_stall_batch.py and bakes
+    its winner here)."""
+    k = int(getattr(cfg, "tpu_wave_stall_batch", -1))
+    if k < 0:
+        k = 4
+    return max(1, min(k, 16))
+
+
 def _correction_reserve(cfg: Config, budget: int) -> int:
     """Worst-case replay correction splits, for slot/hist-pool sizing.
 
@@ -82,7 +94,7 @@ def _correction_reserve(cfg: Config, budget: int) -> int:
     extras (stall_batch > 1) are counted separately in the replay loop
     and capped at ``_stall_extras_cap``.  Shared by ``_init_wave_dims``
     and ``wave_budget_reason`` so the formulas cannot drift."""
-    k = max(1, min(int(getattr(cfg, "tpu_wave_stall_batch", 4)), 16))
+    k = _resolve_stall_batch(cfg)
     return budget if k == 1 else budget + _stall_extras_cap(budget)
 
 
@@ -98,7 +110,7 @@ def _resolve_overshoot(cfg: Config, local_rows: int) -> float:
     round-4 scale-dependent optimum (0.7 at 1M, 0.25 at 10.5M)."""
     ov = float(cfg.tpu_wave_overshoot)
     if ov < 0:
-        if int(getattr(cfg, "tpu_wave_stall_batch", 4)) > 1:
+        if _resolve_stall_batch(cfg) > 1:
             ov = 0.0
         else:
             ov = 0.7 if local_rows <= 2_000_000 else 0.25
@@ -162,6 +174,23 @@ class WaveTPUTreeLearner(CompactTPUTreeLearner):
         while self.n_pad % rb:
             rb //= 2
         self._seg_rb = rb
+        # fused Pallas split-scan (Config.tpu_wave_pallas_scan): the
+        # batched child scans run as one kernel; constrained/categorical/
+        # penalized/f64 configs keep the XLA path (scan_ineligible_reason)
+        from .ops.scan_pallas import scan_ineligible_reason
+        sp = str(getattr(cfg, "tpu_wave_pallas_scan", "auto"))
+        s_reason = scan_ineligible_reason(
+            self.num_features, self.num_bins_padded, self.has_monotone,
+            self.has_categorical, self.has_penalty, self.hist_dp)
+        if sp == "on":
+            self._use_scan = s_reason is None
+            self._scan_interpret = not _on_tpu()
+        elif sp == "auto":
+            self._use_scan = self._use_pallas and s_reason is None
+            self._scan_interpret = False
+        else:
+            self._use_scan = False
+            self._scan_interpret = False
         self._jit_tree_w = jax.jit(self._train_tree_wave)
 
     def _init_wave_dims(self, cfg: Config) -> None:
@@ -205,8 +234,9 @@ class WaveTPUTreeLearner(CompactTPUTreeLearner):
         # sort-deferral alternation (Config.tpu_wave_defer_sorts)
         self._defer_sorts = bool(getattr(cfg, "tpu_wave_defer_sorts", True))
         # replay stall-correction batch width (Config.tpu_wave_stall_batch)
-        self._stall_batch = max(
-            1, min(int(getattr(cfg, "tpu_wave_stall_batch", 4)), 16))
+        self._stall_batch = _resolve_stall_batch(cfg)
+        self._stall_fuse_top = bool(
+            getattr(cfg, "tpu_wave_stall_fuse_top", True))
         self._extras_cap = _stall_extras_cap(self.budget)
         # vectorized-partition span cap (tests shrink it via config so the
         # replicated gate is exercised at CI sizes)
@@ -223,6 +253,29 @@ class WaveTPUTreeLearner(CompactTPUTreeLearner):
         # partition of a shared window would reorder sibling rows)
         self._wave_cutoff = int(cfg.tpu_wave_sort_cutoff)
         self._stall_cutoff = max(self._sort_cutoff, self._wave_cutoff)
+        # Pallas stable-partition kernel (Config.tpu_wave_pallas_partition):
+        # replaces the full-array re-compaction sort with exact
+        # destination computation + a chunked permute kernel.  Partition
+        # mode runs WITHOUT sort-deferral: each wave materializes its own
+        # windows (a partition pass is cheap enough that halving pass
+        # count no longer pays for deferred waves' double-area member
+        # hists), which also means phys_i always equals node_i at the
+        # replay and the dest lane is wave-local (no carried key state)
+        from .ops.partition_pallas import partition_ineligible_reason
+        pp = str(getattr(cfg, "tpu_wave_pallas_partition", "auto"))
+        reason = partition_ineligible_reason(rows, self.M, self.open_levels)
+        if pp == "on":
+            self._use_partition = reason is None
+            self._partition_interpret = not _on_tpu()
+        elif pp == "auto":
+            self._use_partition = (getattr(self, "_use_pallas", False)
+                                   and reason is None)
+            self._partition_interpret = False
+        else:
+            self._use_partition = False
+            self._partition_interpret = False
+        if self._use_partition:
+            self._defer_sorts = False
         # dev-only phase ablation for profiling (profile_wave_phases.py):
         # comma-set of {nohist, noscan, nosort} — NOT a user knob; a leaked
         # env var would silently train WRONG trees, so warn loudly
@@ -243,7 +296,35 @@ class WaveTPUTreeLearner(CompactTPUTreeLearner):
     def _cand_rows_batch(self, hists, sg, sh, cn, feature_mask, depth_ok,
                          constraints):
         """Best-split rows for K children in one vmapped scan
-        (generalizes ``_cand_rows_pair``)."""
+        (generalizes ``_cand_rows_pair``).  With the fused Pallas
+        split-scan enabled the whole (K, F, B) search — cumulative
+        scans, gain masks, per-feature argmax — runs as one kernel."""
+        if getattr(self, "_use_scan", False) and constraints is None:
+            from .learner import _FeatCand
+            from .ops.scan_pallas import find_best_splits_batched
+            h = hists
+            if self._bundle is not None:
+                h = jax.vmap(self._unbundle_hist)(h, sg, sh, cn)
+            h = jax.vmap(self._fix_histogram)(h, sg, sh, cn)
+            kw = {k: v for k, v in self._split_kwargs.items()
+                  if k != "skip_missing_scan"}
+            num = find_best_splits_batched(
+                h, sg, sh, cn, self.f_num_bin, self.f_missing,
+                self.f_default_bin, feature_mask & self._cat_mask,
+                interpret=self._scan_interpret, **kw)
+            kk = num.gain.shape[0]
+            f = self.num_features
+            cands = _FeatCand(
+                gain=num.gain, threshold=num.threshold,
+                default_left=num.default_left,
+                is_cat=jnp.zeros((kk, f), bool),
+                cat_bits=jnp.zeros((kk, f, self.cat_W), jnp.uint32),
+                left_sum_g=num.left_sum_g, left_sum_h=num.left_sum_h,
+                left_cnt=num.left_cnt, right_sum_g=num.right_sum_g,
+                right_sum_h=num.right_sum_h, right_cnt=num.right_cnt,
+                left_output=num.left_output,
+                right_output=num.right_output)
+            return self._pack_cand_rows(cands, depth_ok)
         if constraints is not None:
             mins, maxs = constraints
             cands = jax.vmap(
@@ -559,30 +640,36 @@ class WaveTPUTreeLearner(CompactTPUTreeLearner):
         # already known pre-sort (s and s+lc), so both get final keys here.
         # Starts are routed through the contraction as hi/lo 12-bit planes
         # (one nonzero per row -> each plane f32-exact at any N).
-        starts2 = jnp.stack([ps, ps + lc_w], axis=1)            # (W, 2)
-        planes = jnp.concatenate(
-            [(starts2 >> 12).astype(jnp.float32),
-             (starts2 & 0xFFF).astype(jnp.float32)], axis=1)    # (W, 4)
-
-        def keys(lid_old_c, go_c, sort_c, key_c):
-            mask_f = ((lid_old_c[:, None] == wi[None, :])
-                      & valid[None, :]).astype(jnp.float32)
-            ks = lax.dot_general(mask_f, planes, (((1,), (0,)), ((), ())),
-                                 precision=_HIGH)               # (ch, 4)
-            ki = jnp.rint(ks).astype(jnp.int32)
-            kl = 2 * ((ki[:, 0] << 12) + ki[:, 2])
-            kr = 2 * ((ki[:, 1] << 12) + ki[:, 3])
-            return jnp.where(sort_c, jnp.where(go_c, kl, kr), key_c)
-
-        if Cm == 1:
-            key_p = keys(st.lid_p, go_left, sort_r, st.key_p)
+        # Partition mode needs no carried keys (each wave materializes its
+        # own windows from wave-local destinations) — the pass is skipped.
+        if self._use_partition and not opening:
+            key_p = st.key_p
         else:
-            ch = n // Cm
-            key_p = lax.map(
-                lambda a: keys(*a),
-                (st.lid_p.reshape(Cm, ch), go_left.reshape(Cm, ch),
-                 sort_r.reshape(Cm, ch),
-                 st.key_p.reshape(Cm, ch))).reshape(-1)
+            starts2 = jnp.stack([ps, ps + lc_w], axis=1)        # (W, 2)
+            planes = jnp.concatenate(
+                [(starts2 >> 12).astype(jnp.float32),
+                 (starts2 & 0xFFF).astype(jnp.float32)], axis=1)  # (W, 4)
+
+            def keys(lid_old_c, go_c, sort_c, key_c):
+                mask_f = ((lid_old_c[:, None] == wi[None, :])
+                          & valid[None, :]).astype(jnp.float32)
+                ks = lax.dot_general(mask_f, planes,
+                                     (((1,), (0,)), ((), ())),
+                                     precision=_HIGH)           # (ch, 4)
+                ki = jnp.rint(ks).astype(jnp.int32)
+                kl = 2 * ((ki[:, 0] << 12) + ki[:, 2])
+                kr = 2 * ((ki[:, 1] << 12) + ki[:, 3])
+                return jnp.where(sort_c, jnp.where(go_c, kl, kr), key_c)
+
+            if Cm == 1:
+                key_p = keys(st.lid_p, go_left, sort_r, st.key_p)
+            else:
+                ch = n // Cm
+                key_p = lax.map(
+                    lambda a: keys(*a),
+                    (st.lid_p.reshape(Cm, ch), go_left.reshape(Cm, ch),
+                     sort_r.reshape(Cm, ch),
+                     st.key_p.reshape(Cm, ch))).reshape(-1)
         # ---- ONE stable sort re-compacts every sortable split window.
         # Skipped when the whole wave froze (the tree's bottom waves), when
         # opening mode defers ALL compaction to the materialization sort,
@@ -593,6 +680,78 @@ class WaveTPUTreeLearner(CompactTPUTreeLearner):
         if opening:
             st = st._replace(lid_p=lid_p, key_p=key_p)
             sorted_now = jnp.asarray(False)
+        elif self._use_partition and "nosort" not in self._ablate:
+            # ---- Pallas stable partition (ops/partition_pallas.py): the
+            # permutation the stable sort produces, computed directly —
+            # per-row destinations from two exclusive prefix sums over
+            # the left/right flags plus per-member window bases routed
+            # through the same mask-matmul as the key pass, then one
+            # chunked byte-plane permute kernel.  Record-exact vs the
+            # sort (tests/test_partition.py).
+            sort_now = do_sort
+
+            def run_partition(args):
+                from .ops.partition_pallas import (apply_partition,
+                                                   exclusive_cumsum_i32)
+                bins_p_i, w_p_i, rid_p_i, lid_p_i = args
+                gl = sort_r & go_left
+                gr = sort_r & ~go_left
+                cum = exclusive_cumsum_i32(
+                    jnp.stack([gl, gr]).astype(jnp.int32))
+                cl, cr = cum[0], cum[1]
+                active = sortable
+                ps_s = jnp.where(active, ps, 0)
+                cl_ps = jnp.take(cl, ps_s)
+                cr_ps = jnp.take(cr, ps_s)
+                # member bases shifted by +n so the 13/12-bit plane split
+                # stays non-negative (each plane has one nonzero per row
+                # -> f32-exact at any N <= 2^24)
+                base_l = ps + n - cl_ps
+                base_r = ps + lc_w + n - cr_ps
+                dplanes = jnp.stack(
+                    [(base_l >> 12).astype(jnp.float32),
+                     (base_l & 0xFFF).astype(jnp.float32),
+                     (base_r >> 12).astype(jnp.float32),
+                     (base_r & 0xFFF).astype(jnp.float32)],
+                    axis=1)                                     # (W, 4)
+
+                def dests(lid_old_c, go_c, sort_c, pos_c, cl_c, cr_c):
+                    mask_f = ((lid_old_c[:, None] == wi[None, :])
+                              & valid[None, :]).astype(jnp.float32)
+                    ks = lax.dot_general(mask_f, dplanes,
+                                         (((1,), (0,)), ((), ())),
+                                         precision=_HIGH)       # (ch, 4)
+                    ki = jnp.rint(ks).astype(jnp.int32)
+                    bl = (ki[:, 0] << 12) + ki[:, 1] - n
+                    br = (ki[:, 2] << 12) + ki[:, 3] - n
+                    return jnp.where(
+                        sort_c, jnp.where(go_c, bl + cl_c, br + cr_c),
+                        pos_c)
+
+                pos = jnp.arange(n, dtype=jnp.int32)
+                if Cm == 1:
+                    dest = dests(st.lid_p, go_left, sort_r, pos, cl, cr)
+                else:
+                    ch = n // Cm
+                    dest = lax.map(
+                        lambda a: dests(*a),
+                        (st.lid_p.reshape(Cm, ch),
+                         go_left.reshape(Cm, ch),
+                         sort_r.reshape(Cm, ch), pos.reshape(Cm, ch),
+                         cl.reshape(Cm, ch),
+                         cr.reshape(Cm, ch))).reshape(-1)
+                return apply_partition(
+                    bins_p_i, w_p_i, rid_p_i, lid_p_i, dest,
+                    sort_r.astype(jnp.int32), ps, lc_w, cw, active,
+                    cl, cr, cl_ps, cr_ps,
+                    interpret=self._partition_interpret)
+
+            bins_p, w_p, rid_p, lid_p = lax.cond(
+                sort_now, run_partition, lambda a: a,
+                (st.bins_p, st.w_p, st.rid_p, lid_p))
+            st = st._replace(bins_p=bins_p, w_p=w_p, rid_p=rid_p,
+                             lid_p=lid_p)
+            sorted_now = sort_now
         elif "nosort" not in self._ablate:
             if self._defer_sorts:
                 sort_now = st.pending
@@ -1071,7 +1230,7 @@ class WaveTPUTreeLearner(CompactTPUTreeLearner):
         return branch
 
     def _stall_split_batch(self, st: WaveState, tops, bvalid,
-                           feature_mask) -> WaveState:
+                           feature_mask, top_fits=None) -> WaveState:
         """Split up to K frontier leaves in ONE replay correction pass.
 
         Availability advances only by pops (a split never reveals its
@@ -1109,35 +1268,64 @@ class WaveTPUTreeLearner(CompactTPUTreeLearner):
         # loop paid ~0.4 ms per member in switch dispatches)
         lid_p = st.lid_p
         cs = jnp.where(bvalid, spans[:, 1], 0)
-        # the TOP (member 0) partitions through its own bucket switch —
-        # its span is ungated; an invalid/zero-count member degrades to a
-        # zero-row no-op in the smallest bucket, writes masked or dropped
-        crow0 = st.cand_i[tops[0]]
-        lid_p, lc0, c0 = lax.switch(
-            self._bucket_idx(jnp.maximum(cs[0], 1)),
-            self._stall_mask_branches, bins_p, w_p, lid_p,
-            spans[0, 0], cs[0], tops[0], crow0[CI_FEAT], crow0[CI_THR],
-            (crow0[CI_FLAGS] & 1) == 1, (crow0[CI_FLAGS] & 2) == 2,
-            st.cand_b[tops[0]], l0s[0], r0s[0])
-        if K > 1:
-            # the EXTRAS (span-gated <= _VEC_CAP in do_stall) partition in
-            # ONE vectorized stage
+
+        def part_two_stage(lid_in):
+            # the TOP (member 0) partitions through its own bucket switch
+            # — its span is ungated; an invalid/zero-count member
+            # degrades to a zero-row no-op in the smallest bucket, writes
+            # masked or dropped
+            crow0 = st.cand_i[tops[0]]
+            lid2, lc0, c0 = lax.switch(
+                self._bucket_idx(jnp.maximum(cs[0], 1)),
+                self._stall_mask_branches, bins_p, w_p, lid_in,
+                spans[0, 0], cs[0], tops[0], crow0[CI_FEAT],
+                crow0[CI_THR], (crow0[CI_FLAGS] & 1) == 1,
+                (crow0[CI_FLAGS] & 2) == 2, st.cand_b[tops[0]],
+                l0s[0], r0s[0])
+            if K == 1:
+                return lid2, lc0[None], c0[None]
+            # the EXTRAS (span-gated <= _VEC_CAP in do_stall) partition
+            # in ONE vectorized stage
             ci_e = st.cand_i[tops[1:]]
             vsz = self._vec_sizes_arr
             vidx = jnp.sum(jnp.maximum(jnp.max(cs[1:]), 1)
                            > vsz).astype(jnp.int32)
             vidx = jnp.minimum(vidx, len(self._stall_vec_branches) - 1)
-            lid_p, lc_e, c_e = lax.switch(
-                vidx, self._stall_vec_branches, bins_p, w_p, lid_p,
+            lid2, lc_e, c_e = lax.switch(
+                vidx, self._stall_vec_branches, bins_p, w_p, lid2,
                 spans[1:, 0], cs[1:], tops[1:], ci_e[:, CI_FEAT],
                 ci_e[:, CI_THR], (ci_e[:, CI_FLAGS] & 1) == 1,
                 (ci_e[:, CI_FLAGS] & 2) == 2, st.cand_b[tops[1:]],
                 l0s[1:], r0s[1:])
-            lc_s = jnp.concatenate([lc0[None], lc_e])
-            c_s = jnp.concatenate([c0[None], c_e])
+            return (lid2, jnp.concatenate([lc0[None], lc_e]),
+                    jnp.concatenate([c0[None], c_e]))
+
+        if K > 1 and self._stall_fuse_top and top_fits is not None:
+            # when the top's span ALSO fits the vec cap (the common case
+            # — big spans stall early, at the top of the tree), the
+            # whole event is ONE masked pass: one switch dispatch instead
+            # of two.  Exact: both stages share _span_decide and the lid
+            # rewrites are disjoint.  top_fits is REPLICATED (do_stall
+            # derives it from the pmax'd spans), so the cond cannot
+            # diverge across shards
+            def part_fused(lid_in):
+                ci_a = st.cand_i[tops]
+                vsz = self._vec_sizes_arr
+                vidx = jnp.sum(jnp.maximum(jnp.max(cs), 1)
+                               > vsz).astype(jnp.int32)
+                vidx = jnp.minimum(vidx,
+                                   len(self._stall_vec_branches_all) - 1)
+                return lax.switch(
+                    vidx, self._stall_vec_branches_all, bins_p, w_p,
+                    lid_in, spans[:, 0], cs, tops, ci_a[:, CI_FEAT],
+                    ci_a[:, CI_THR], (ci_a[:, CI_FLAGS] & 1) == 1,
+                    (ci_a[:, CI_FLAGS] & 2) == 2, st.cand_b[tops],
+                    l0s, r0s)
+
+            lid_p, lc_s, c_s = lax.cond(top_fits, part_fused,
+                                        part_two_stage, lid_p)
         else:
-            lc_s = lc0[None]
-            c_s = c0[None]
+            lid_p, lc_s, c_s = part_two_stage(lid_p)
         # ONE count sync (the sharded learners psum the (K,) pair once
         # instead of per member)
         lc_a, c_a = self._sync_counts(lc_s, c_s)
@@ -1212,6 +1400,12 @@ class WaveTPUTreeLearner(CompactTPUTreeLearner):
             self._stall_vec_branches = [
                 self._make_stall_vec_branch(S, self._stall_batch - 1)
                 for S in vec_sizes]
+            if self._stall_fuse_top:
+                # K-wide variant for events whose TOP also fits the vec
+                # cap: the whole correction partitions in ONE masked pass
+                self._stall_vec_branches_all = [
+                    self._make_stall_vec_branch(S, self._stall_batch)
+                    for S in vec_sizes]
         M, budget = self.M, self.budget
         OOB = jnp.int32(M + 7)
         NEG = jnp.finfo(jnp.float32).min
@@ -1361,7 +1555,8 @@ class WaveTPUTreeLearner(CompactTPUTreeLearner):
                 fits = self._replicated_spans(s.phys_i[tops_k, 1]) \
                     <= jnp.int32(self._vec_cap)
                 bv = bv & ((head & fits) | (jnp.arange(Kb) == 0))
-                s2 = self._stall_split_batch(s, tops_k, bv, feature_mask)
+                s2 = self._stall_split_batch(s, tops_k, bv, feature_mask,
+                                             top_fits=fits[0])
                 nsp = jnp.sum(bv, dtype=jnp.int32).astype(jnp.int32)
                 return s2, nsp, nsp - bv[0].astype(jnp.int32)
 
@@ -1555,19 +1750,24 @@ def wave_transient_bytes(cfg: Config, n_pad: int, f_pad: int, b: int
     m_pad = ((M + 127) // 128) * 128
     mask_bytes = min(n_pad, 1 << 20) * W * 4 + n_pad * 12
     lookup_bytes = min(n_pad, 1 << 17) * m_pad * 4
-    # double-buffered sort operands (key + fw words + 3 weights + rid + lid)
+    # double-buffered sort operands (key + fw words + 3 weights + rid +
+    # lid).  Also covers partition mode: the permute kernel's bf16
+    # byte-plane output is (4·fw + 17) * 2 bytes/row ≈ (8·fw + 34)·n vs
+    # the sort's (8·fw + 48)·n, so the sort term is the conservative
+    # bound for either flow
     sort_bytes = 2 * (f_pad // 4 + 6) * n_pad * 4
     # batched replay correction: the vectorized partition stacks the K-1
     # extras' (fw, S) bin-word + (3, S) weight + (S,) lid slices, S up to
     # the vec cap — on wide datasets (fw in the hundreds) this per-event
     # transient is material and must count against the budget (round-5
     # advisor, low)
-    k = max(1, min(int(getattr(cfg, "tpu_wave_stall_batch", 4)), 16))
+    k = _resolve_stall_batch(cfg)
     vc = int(getattr(cfg, "tpu_wave_vec_cap", -1))
     if vc <= 0:
         vc = WaveTPUTreeLearner._VEC_CAP
+    # k (not k-1) slices: the fused-top path stacks every member's slice
     stall_vec_bytes = 0 if k == 1 else \
-        (k - 1) * min(vc, n_pad) * (f_pad // 4 + 4) * 4
+        k * min(vc, n_pad) * (f_pad // 4 + 4) * 4
     out = {"hist_pool_bytes": h_bytes, "child_scan_bytes": scan_bytes,
            "wave_mask_bytes": mask_bytes, "leaf_lookup_bytes": lookup_bytes,
            "sort_buffer_bytes": sort_bytes,

@@ -1,0 +1,242 @@
+"""Pallas TPU fused split-scan kernel (the reference's second kernel).
+
+The OpenCL reference pairs its histogram kernels with a split-scan kernel
+that walks the cumulative histogram and reduces the best threshold per
+feature on-device; this port fuses the same stages for the wave learner's
+batched child scans: for a (leaves, features, bins, 3) histogram cube, ONE
+kernel computes both missing-direction cumulative scans (as triangular
+MXU contractions — the exact matrices ``ops/split.py`` uses on its
+``_scan_by_dot`` path), evaluates the reference gain formula with the
+validity masks, and reduces the per-feature best (gain, threshold,
+direction, child aggregates) — replacing the XLA scan+argmax chain whose
+~15 intermediate (K·F·B) arrays round-trip HBM between fused ops.
+
+Semantics are ``find_best_splits``'s exactly (missing-left/right scan
+exclusions, L1/L2/max_delta_step gain math, min_data/min_hessian
+feasibility, the largest-threshold tie-break missing-left and smallest
+missing-right, strict-> override); monotone constraints, categorical
+features and feature penalties keep the XLA path (the learner gates).
+Golden parity vs ``find_best_splits`` on dyadic inputs is bit-exact
+(tests/test_partition.py); on arbitrary f32 inputs the two paths differ
+only by summation-order ulps, the same accepted regime as the
+``_scan_by_dot`` fast path (`docs/GPU-Performance.rst:137-141`).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..binning import MISSING_NAN, MISSING_NONE, MISSING_ZERO
+from .split import (K_EPSILON, K_MIN_SCORE, SplitCandidates,
+                    calculate_leaf_output, leaf_split_gain,
+                    leaf_split_gain_given_output)
+
+# jax >= 0.5 renamed TPUCompilerParams -> CompilerParams
+_CompilerParams = getattr(pltpu, "CompilerParams", None) \
+    or pltpu.TPUCompilerParams
+
+#: output planes: gain, threshold, default_left, lg, lh(+eps), lc, lo, ro
+N_OUT = 8
+
+
+def _scan_kernel(hist_ref, tot_ref, nb_ref, mt_ref, db_ref,
+                 out_ref, *, b: int, f: int, lambda_l1: float,
+                 lambda_l2: float, max_delta_step: float,
+                 min_data_in_leaf: int, min_sum_hessian_in_leaf: float,
+                 min_gain_to_split: float):
+    l1, l2, mds = lambda_l1, lambda_l2, max_delta_step
+    h = hist_ref[0]                              # (F, 3, B)
+    hg, hh, hc = h[:, 0, :], h[:, 1, :], h[:, 2, :]
+    total_g = tot_ref[0, 0]
+    total_h = tot_ref[0, 1] + 2.0 * K_EPSILON
+    total_n = tot_ref[0, 2]
+    nb = nb_ref[...][:, None]                    # (F, 1)
+    mtype = mt_ref[...][:, None]
+    d_bin = db_ref[...][:, None]
+    iota_b = lax.broadcasted_iota(jnp.int32, (f, b), 1)
+    two = (nb > 2) & (mtype != MISSING_NONE)
+    is_zero = mtype == MISSING_ZERO
+    is_nan = mtype == MISSING_NAN
+
+    gain_shift = leaf_split_gain(total_g, total_h, l1, l2, mds)
+    min_gain_shift = gain_shift + min_gain_to_split
+
+    def split_gains(lg, lh, rg, rh):
+        lo = calculate_leaf_output(lg, lh, l1, l2, mds)
+        ro = calculate_leaf_output(rg, rh, l1, l2, mds)
+        gain = (leaf_split_gain_given_output(lg, lh, l1, l2, lo)
+                + leaf_split_gain_given_output(rg, rh, l1, l2, ro))
+        return gain, lo, ro
+
+    def tri_dot(keep, lower_strict):
+        """Σ_b hist[..., b]·M[b, t] — the same triangular matrices (and
+        HIGHEST-precision contraction) as ops/split.py's dot path; 2D
+        operands only (Mosaic's dot support)."""
+        io0 = lax.broadcasted_iota(jnp.int32, (b, b), 0)
+        io1 = lax.broadcasted_iota(jnp.int32, (b, b), 1)
+        m = (io0 > io1) if lower_strict else (io0 <= io1)
+        xs = jnp.concatenate([hg * keep, hh * keep, hc * keep],
+                             axis=0)                         # (3F, B)
+        out = lax.dot_general(xs, m.astype(jnp.float32),
+                              (((1,), (0,)), ((), ())),
+                              precision=lax.Precision.HIGHEST)
+        return out[:f], out[f:2 * f], out[2 * f:]
+
+    # ---- missing-left scan (suffix sums over bins > t)
+    excl_m1 = (two & is_zero & (iota_b == d_bin)) | \
+              (two & is_nan & (iota_b >= nb - 1)) | (iota_b >= nb)
+    keep = (~excl_m1).astype(jnp.float32)
+    rg_m1, rh_m1, rc_m1 = tri_dot(keep, lower_strict=True)
+    rh_m1 = rh_m1 + K_EPSILON
+    lg_m1 = total_g - rg_m1
+    lh_m1 = total_h - rh_m1
+    lc_m1 = total_n - rc_m1
+    thr_hi = jnp.where(two & is_nan, nb - 3, nb - 2)
+    valid_m1 = (iota_b <= thr_hi)
+    valid_m1 &= ~(two & is_zero & (iota_b == d_bin - 1))
+    valid_m1 &= (rc_m1 >= min_data_in_leaf) & (lc_m1 >= min_data_in_leaf)
+    valid_m1 &= (rh_m1 >= min_sum_hessian_in_leaf) & \
+        (lh_m1 >= min_sum_hessian_in_leaf)
+    g_m1, lo_m1, ro_m1 = split_gains(lg_m1, lh_m1, rg_m1, rh_m1)
+    g_m1 = jnp.where(valid_m1 & (g_m1 > min_gain_shift), g_m1, K_MIN_SCORE)
+    best_g_m1 = jnp.max(g_m1, axis=1)                      # (F,)
+    # largest threshold wins ties (right-to-left scan with strict >)
+    thr_m1 = jnp.max(jnp.where(g_m1 == best_g_m1[:, None], iota_b, -1),
+                     axis=1)
+
+    # ---- missing-right scan (prefix sums over bins <= t)
+    excl_p1 = (is_zero & (iota_b == d_bin)) | \
+              (is_nan & (iota_b >= nb - 1)) | (iota_b >= nb)
+    keep_p = (~excl_p1).astype(jnp.float32)
+    lg_p1, lh_p1, lc_p1 = tri_dot(keep_p, lower_strict=False)
+    lh_p1 = lh_p1 + K_EPSILON
+    rg_p1 = total_g - lg_p1
+    rh_p1 = total_h - lh_p1
+    rc_p1 = total_n - lc_p1
+    valid_p1 = two & (iota_b <= nb - 2)
+    valid_p1 &= ~(is_zero & (iota_b == d_bin))
+    valid_p1 &= (lc_p1 >= min_data_in_leaf) & (rc_p1 >= min_data_in_leaf)
+    valid_p1 &= (lh_p1 >= min_sum_hessian_in_leaf) & \
+        (rh_p1 >= min_sum_hessian_in_leaf)
+    g_p1, lo_p1, ro_p1 = split_gains(lg_p1, lh_p1, rg_p1, rh_p1)
+    g_p1 = jnp.where(valid_p1 & (g_p1 > min_gain_shift), g_p1, K_MIN_SCORE)
+    best_g_p1 = jnp.max(g_p1, axis=1)
+    # smallest threshold wins (left-to-right scan with strict >)
+    thr_p1 = jnp.min(jnp.where(g_p1 == best_g_p1[:, None], iota_b, b),
+                     axis=1)
+
+    # ---- combine (missing-right overrides on strictly greater gain)
+    use_p1 = best_g_p1 > best_g_m1
+    best_t = jnp.where(use_p1, thr_p1, thr_m1)
+    best_g = jnp.where(use_p1, best_g_p1, best_g_m1)
+    two1 = two[:, 0]
+    dleft = jnp.where(use_p1, False,
+                      ~((~two1) & (mt_ref[...] == MISSING_NAN)))
+
+    def take(a_m1, a_p1):
+        sel = iota_b == best_t[:, None]
+        pick = lambda a: jnp.sum(jnp.where(sel, a, 0.0), axis=1)
+        return jnp.where(use_p1, pick(a_p1), pick(a_m1))
+
+    lg_b = take(lg_m1, lg_p1)
+    lh_b = take(lh_m1, lh_p1)
+    lc_b = take(lc_m1, lc_p1)
+    lo_b = take(lo_m1, lo_p1)
+    ro_b = take(ro_m1, ro_p1)
+    out_ref[0, :, :] = jnp.stack([
+        best_g, best_t.astype(jnp.float32), dleft.astype(jnp.float32),
+        lg_b, lh_b, lc_b, lo_b, ro_b])
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "lambda_l1", "lambda_l2", "max_delta_step", "min_data_in_leaf",
+    "min_sum_hessian_in_leaf", "min_gain_to_split", "interpret"))
+def find_best_splits_batched(hist, sum_gradients, sum_hessians, num_data,
+                             num_bin, missing_type, default_bin,
+                             feature_mask, *, lambda_l1: float = 0.0,
+                             lambda_l2: float = 0.0,
+                             max_delta_step: float = 0.0,
+                             min_data_in_leaf: int = 20,
+                             min_sum_hessian_in_leaf: float = 1e-3,
+                             min_gain_to_split: float = 0.0,
+                             interpret: bool = False) -> SplitCandidates:
+    """Batched ``find_best_splits`` through the fused Pallas kernel.
+
+    hist : (K, F, B, 3) f32 — one leaf per K slot (already FixHistogram'd
+           / unbundled by the caller); sum_* / num_data (K,); feature
+           meta (F,) int32.  Returns a (K, F)-batched SplitCandidates —
+    the same post-shift gain / epsilon-carry conventions as the XLA path.
+    """
+    k, f, b, _ = hist.shape
+    dt = hist.dtype
+    total_g = sum_gradients.astype(dt)
+    total_h = sum_hessians.astype(dt) + 2.0 * K_EPSILON
+    total_n = num_data.astype(dt)
+    hist_t = hist.transpose(0, 1, 3, 2)           # (K, F, 3, B): B in lanes
+    totals = jnp.stack([sum_gradients, sum_hessians, num_data],
+                       axis=1).astype(jnp.float32)            # (K, 3)
+    out = pl.pallas_call(
+        functools.partial(
+            _scan_kernel, b=b, f=f, lambda_l1=lambda_l1,
+            lambda_l2=lambda_l2, max_delta_step=max_delta_step,
+            min_data_in_leaf=min_data_in_leaf,
+            min_sum_hessian_in_leaf=min_sum_hessian_in_leaf,
+            min_gain_to_split=min_gain_to_split),
+        grid=(k,),
+        in_specs=[
+            pl.BlockSpec((1, f, 3, b), lambda i: (i, 0, 0, 0)),
+            pl.BlockSpec((1, 3), lambda i: (i, 0)),
+            pl.BlockSpec((f,), lambda i: (0,)),
+            pl.BlockSpec((f,), lambda i: (0,)),
+            pl.BlockSpec((f,), lambda i: (0,)),
+        ],
+        out_specs=pl.BlockSpec((1, N_OUT, f), lambda i: (i, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((k, N_OUT, f), jnp.float32),
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel",)),
+        interpret=interpret,
+    )(hist_t, totals, num_bin.astype(jnp.int32),
+      missing_type.astype(jnp.int32), default_bin.astype(jnp.int32))
+    best_g = out[:, 0, :]
+    best_t = jnp.rint(out[:, 1, :]).astype(jnp.int32)
+    dleft = out[:, 2, :] > 0.5
+    lg_b, lh_b, lc_b = out[:, 3, :], out[:, 4, :], out[:, 5, :]
+    lo_b, ro_b = out[:, 6, :], out[:, 7, :]
+    gain_shift = leaf_split_gain(total_g, total_h, lambda_l1, lambda_l2,
+                                 max_delta_step)
+    min_gain_shift = (gain_shift + min_gain_to_split)[:, None]
+    invalid = jnp.isneginf(best_g) | ~feature_mask[None, :]
+    tg, th, tn = total_g[:, None], total_h[:, None], total_n[:, None]
+    return SplitCandidates(
+        gain=jnp.where(invalid, K_MIN_SCORE, best_g - min_gain_shift),
+        threshold=best_t,
+        default_left=dleft,
+        left_sum_g=lg_b, left_sum_h=lh_b - K_EPSILON, left_cnt=lc_b,
+        right_sum_g=tg - lg_b, right_sum_h=th - lh_b - K_EPSILON,
+        right_cnt=tn - lc_b,
+        left_output=lo_b, right_output=ro_b)
+
+
+def scan_ineligible_reason(f: int, b: int, has_monotone: bool,
+                           has_categorical: bool, has_penalty: bool,
+                           hist_dp: bool):
+    """Why the fused scan cannot serve this learner (None = eligible)."""
+    if has_monotone:
+        return "monotone constraints need the per-leaf bound plumbing"
+    if has_categorical:
+        return "categorical candidates merge through the XLA path"
+    if has_penalty:
+        return "feature_contri penalties apply on the XLA path"
+    if hist_dp:
+        return "f64 histograms (gpu_use_dp analogue) stay on XLA"
+    if b > 512:
+        return f"{b} bins > 512 (triangular scan block)"
+    if f * b * 12 > (1 << 22):
+        return "histogram block exceeds the 4MB VMEM budget"
+    return None

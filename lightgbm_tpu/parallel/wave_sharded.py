@@ -22,6 +22,16 @@ the serial learner):
 
 Exactness: the records stream is identical to the serial wave learner's
 (`tests/test_parallel.py::test_wave_sharded_records_match_serial`).
+
+Round 6: the Pallas stable-partition kernel composes here PER SHARD —
+``_wave_body`` (shared with the serial learner) computes destinations
+from LOCAL window geometry and local prefix sums and permutes only the
+local rows, so ``tpu_wave_pallas_partition`` changes ZERO collective
+sites (`analysis/budgets.json` pins them); ``_init_wave_dims`` re-runs
+with the shard-local row count, so the 2^24-row eligibility gate applies
+per shard.  The fused split-scan does NOT apply here: the sharded
+candidate scans go through ``_best_rows_global`` (feature-slice scans +
+all_gather), which overrides ``_cand_rows_batch`` entirely.
 """
 
 from __future__ import annotations

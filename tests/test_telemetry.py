@@ -302,9 +302,11 @@ def test_wave_budget_counts_stall_vec_transient():
     cfg = Config.from_params({"num_leaves": 255, "tpu_wave_stall_batch": 4})
     n_pad, f_pad, b = 1 << 20, 32, 256
     bb = wave_transient_bytes(cfg, n_pad, f_pad, b)
+    # k (not k-1) slices since round 6: the fused-top correction path
+    # (tpu_wave_stall_fuse_top) stacks every member's slice
     k, cap = 4, WaveTPUTreeLearner._VEC_CAP
     assert bb["stall_vec_bytes"] == \
-        (k - 1) * min(cap, n_pad) * (f_pad // 4 + 4) * 4
+        k * min(cap, n_pad) * (f_pad // 4 + 4) * 4
     assert bb["total_bytes"] == sum(v for kk, v in bb.items()
                                     if kk != "total_bytes")
     # K=1 has no vectorized extras stage
@@ -314,7 +316,7 @@ def test_wave_budget_counts_stall_vec_transient():
     cfg_s = Config.from_params({"num_leaves": 255, "tpu_wave_stall_batch": 4,
                                 "tpu_wave_vec_cap": 1024})
     assert wave_transient_bytes(cfg_s, n_pad, f_pad, b)["stall_vec_bytes"] \
-        == (k - 1) * 1024 * (f_pad // 4 + 4) * 4
+        == k * 1024 * (f_pad // 4 + 4) * 4
     # wide datasets: the transient scales with the word count, the round-5
     # advisor's concern — hundreds of columns make it budget-material
     bb_wide = wave_transient_bytes(cfg, n_pad, 1024, b)
