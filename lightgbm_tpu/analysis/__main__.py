@@ -97,12 +97,20 @@ def main(argv=None) -> int:
     pass_results: Dict[str, Dict[str, object]] = {}
 
     if "lint" in selected:
-        log("pass 1/4: AST repo lint ...")
+        log("pass 1/4: AST repo lint + report schema drift ...")
         kept, suppressed = lint.run()
+        # LGB006: the emitted telemetry/serving reports vs schema.json —
+        # drift (a section key without a schema property, or a report the
+        # validator rejects) gates the same way an AST finding does
+        from .common import apply_allowlist, load_allowlist
+        drift_kept, drift_sup = apply_allowlist(lint.schema_drift(),
+                                                load_allowlist())
+        kept = kept + drift_kept
         findings.extend(kept)
         pass_results["lint"] = {
             "status": "findings" if kept else "ok",
-            "findings": len(kept), "suppressed": len(suppressed)}
+            "findings": len(kept),
+            "suppressed": len(suppressed) + len(drift_sup)}
 
     if "races" in selected:
         log("pass 2/4: lock-order race detector ...")

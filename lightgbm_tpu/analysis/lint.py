@@ -27,9 +27,16 @@ to the failure classes PRs 1-4 fixed by hand.  Rules:
     perf_counter) in modules whose functions are traced into XLA programs:
     a wall clock read at trace time bakes a constant into the compiled
     program, silently wrong on every later call.
+  * **LGB006-schema-drift** — every key the telemetry/serving reports
+    actually emit must have a property in ``observability/schema.json``
+    (and the emitted reports must validate).  A report key added without
+    a schema entry is exactly how "schema-validated" silently stops
+    meaning anything; the drift becomes a gate finding instead
+    (``schema_drift()``, run by ``python -m lightgbm_tpu.analysis``).
 
-All rules are heuristic AST checks scoped to one function at a time; the
-checked-in ``allowlist.json`` records every vetted exception with a reason.
+All rules are heuristic AST checks scoped to one function at a time
+(LGB006 builds live reports instead); the checked-in ``allowlist.json``
+records every vetted exception with a reason.
 """
 
 from __future__ import annotations
@@ -296,6 +303,48 @@ def lint_file(path: str, traced: Optional[bool] = None) -> List[Finding]:
                 f"trace-time constant into the compiled program",
                 line=node.lineno))
 
+    return findings
+
+
+def schema_drift() -> List[Finding]:
+    """LGB006: build the real telemetry and serving reports and check
+    every emitted section key has an ``observability/schema.json``
+    property — plus a full validator pass over both.  Run as part of the
+    gate's lint pass so adding a report key without a schema entry (or
+    vice versa breaking validation) is a pre-merge finding, not a
+    surprise when a driver chokes on the report."""
+    from ..observability.report import load_schema, validate_report
+    from ..observability.telemetry import Telemetry
+    from ..serving.batcher import ServingStats
+
+    sfile = "lightgbm_tpu/observability/schema.json"
+    schema = load_schema()
+    props = schema.get("properties", {})
+    findings: List[Finding] = []
+    reports = {
+        "Telemetry.report": Telemetry(True).report(),
+        "ServingStats.report": ServingStats().report(),
+    }
+    for sym, rep in reports.items():
+        for key in rep:
+            if key not in props:
+                findings.append(Finding(
+                    "lint", "LGB006-schema-drift", sfile,
+                    f"report section {key!r} emitted by {sym} has no "
+                    f"schema.json property — add it (or stop emitting it)",
+                    symbol=sym))
+        for err in validate_report(rep, schema):
+            findings.append(Finding(
+                "lint", "LGB006-schema-drift", sfile,
+                f"{sym} report violates schema.json: {err}", symbol=sym))
+    serving_props = props.get("serving", {}).get("properties", {})
+    for key in reports["ServingStats.report"].get("serving", {}):
+        if key not in serving_props:
+            findings.append(Finding(
+                "lint", "LGB006-schema-drift", sfile,
+                f"serving section key {key!r} (ServingStats."
+                f"serving_section) has no schema.json property",
+                symbol="ServingStats.serving_section"))
     return findings
 
 

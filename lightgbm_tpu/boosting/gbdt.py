@@ -373,8 +373,10 @@ class GBDT:
                               "leaves that meet the split requirements")
                 break
         if tel.enabled:
+            # t0 makes the flush land as a trace span too (trace_out)
             tel.add_phase_time("pipeline_flush",
-                               time.perf_counter() - _flush_t0)
+                               time.perf_counter() - _flush_t0,
+                               t0=_flush_t0)
             tel.inc("pipeline_flushes")
             tel.inc("trees_assembled", len(pend))
             if keep == 0:
@@ -388,8 +390,19 @@ class GBDT:
         """Materialize one queued pipelined tree into ``self._models``;
         returns its model index."""
         idx, rf, ri, rc, init_sc = entry
+        # span only when telemetry is on: an attached-but-idle recorder on
+        # a telemetry-off booster must record nothing (same invariant the
+        # phase timers keep)
+        tr = self.telemetry.tracer if self.telemetry.enabled else None
+        _t0 = time.perf_counter() if tr is not None else 0.0
         tree = self.learner.assemble_host(np.asarray(rf), np.asarray(ri),
                                           np.asarray(rc))
+        if tr is not None:
+            # per-tree host-assembly span: which tree a long flush spent
+            # its time on (the aggregate lands in phase pipeline_flush)
+            tr.add_complete("tree_assemble", _t0,
+                            time.perf_counter() - _t0, cat="train",
+                            args={"model_index": int(idx)})
         if tree.num_leaves > 1:
             tree.apply_shrinkage(self.shrinkage_rate)
             if abs(init_sc) > kEpsilon:

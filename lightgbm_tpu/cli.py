@@ -77,8 +77,9 @@ def run_train(params: Dict[str, str], cfg: Config) -> None:
     from . import engine
     from .dataset import Dataset
 
-    # --telemetry-out implies telemetry: asking for the report IS opting in
-    if cfg.telemetry_out and not cfg.telemetry:
+    # --telemetry-out / --trace-out imply telemetry: asking for the
+    # report (or for spans, which ride the phase timers) IS opting in
+    if (cfg.telemetry_out or cfg.trace_out) and not cfg.telemetry:
         cfg.telemetry = True
         params = dict(params, telemetry="true")
     if cfg.resume:
@@ -113,6 +114,9 @@ def run_train(params: Dict[str, str], cfg: Config) -> None:
     if cfg.telemetry and cfg.telemetry_out:
         # engine.train wrote the report already; log where it landed
         _log(f"Telemetry report written to {cfg.telemetry_out}")
+    if cfg.trace_out:
+        _log(f"Trace written to {cfg.trace_out} "
+             f"(open in Perfetto / chrome://tracing)")
     _log(f"Finished training in {time.time() - t0:.6f} seconds")
 
 
@@ -180,7 +184,11 @@ def run_serve(params: Dict[str, str], cfg: Config) -> None:
     (`lightgbm_tpu/serving/`).  Blocks until a client sends ``shutdown``
     or the process receives SIGINT; ``--telemetry-out`` writes the serving
     telemetry report (``serving`` section of observability/schema.json)
-    on exit."""
+    on exit, ``--stats-out FILE --stats-interval S`` additionally writes
+    periodic atomic schema-validated snapshots of the same report while
+    serving (poll the file instead of the socket op), and ``--trace-out``
+    records request-scoped spans written as Chrome trace-event JSON on
+    shutdown."""
     from .engine import Booster
 
     if not cfg.input_model:
@@ -195,9 +203,15 @@ def run_serve(params: Dict[str, str], cfg: Config) -> None:
         deadline_ms=cfg.serve_deadline_ms,
         min_bucket=cfg.serve_min_bucket, warmup=cfg.serve_warmup,
         max_inflight=cfg.serve_max_inflight,
-        telemetry_out=cfg.telemetry_out)
+        telemetry_out=cfg.telemetry_out,
+        trace_out=cfg.trace_out, trace_capacity=cfg.trace_capacity,
+        stats_out=cfg.serve_stats_out,
+        stats_interval_s=cfg.serve_stats_interval)
     _log(f"Serving {cfg.input_model} at {server.host}:{server.port} "
          f"(buckets {server.buckets}, deadline {cfg.serve_deadline_ms} ms)")
+    if cfg.serve_stats_out:
+        _log(f"Stats snapshots every {cfg.serve_stats_interval:g}s to "
+             f"{cfg.serve_stats_out}")
     try:
         server.wait()
     except KeyboardInterrupt:
@@ -206,6 +220,8 @@ def run_serve(params: Dict[str, str], cfg: Config) -> None:
         server.stop()
     if cfg.telemetry_out:
         _log(f"Serving telemetry report written to {cfg.telemetry_out}")
+    if cfg.trace_out:
+        _log(f"Serving trace written to {cfg.trace_out}")
     _log("Finished serving")
 
 

@@ -123,6 +123,11 @@ ALIAS_TABLE: Dict[str, str] = {
     "machine_list_file": "machine_list_filename",
     "machine_list": "machine_list_filename", "mlist": "machine_list_filename",
     "workers": "machines", "nodes": "machines",
+    # observability (so the CLI flags --stats-out / --stats-interval land
+    # on the serve_* keys)
+    "stats_out": "serve_stats_out",
+    "stats_interval": "serve_stats_interval",
+    "trace_file": "trace_out",
 }
 
 _OBJECTIVE_ALIASES = {
@@ -399,6 +404,17 @@ class Config:
     # this output directory — real per-op device timings over the tunnel
     # (profiling/PROFILE.md); independent of the counter layer above
     profile_trace_dir: str = ""
+    # write a Chrome trace-event JSON of the host-side structured spans
+    # (observability/trace.py — open in Perfetto / chrome://tracing).
+    # Training: spans ride the existing phase timers, so trace_out implies
+    # telemetry=True; written when engine.train returns.  Serving
+    # (task=serve): per-request/batch/stage spans linked by trace_id,
+    # written at server stop.  Host-only + monotonic clocks: the traced
+    # XLA programs are untouched (jaxprs byte-identical with tracing off)
+    trace_out: str = ""
+    # span ring-buffer capacity: a long-lived server overwrites its
+    # oldest spans past this instead of growing without bound
+    trace_capacity: int = 65536
     # dev/test knob: override the batched replay correction's vectorized
     # span cap (_VEC_CAP, default 2^17 rows).  Tests shrink it so the
     # replicated span gate is exercised at CI problem sizes
@@ -422,6 +438,13 @@ class Config:
     # admission and response; the rest shed with a structured
     # {"error": "overloaded"} frame (reliability/degrade.py)
     serve_max_inflight: int = 64
+    # periodic operator-pollable stats snapshots: every
+    # serve_stats_interval seconds the full schema-validated telemetry
+    # report is written atomically (tmp + os.replace) to serve_stats_out,
+    # so operators poll a file instead of holding a socket op open
+    # (aliases: stats_out / stats_interval)
+    serve_stats_out: str = ""
+    serve_stats_interval: float = 10.0
     # replay stall correction batch: when the exact greedy replay reaches
     # a leaf the speculative growth never split, split up to this many of
     # the highest-priority unsplit frontier leaves in ONE correction pass

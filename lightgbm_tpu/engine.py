@@ -238,7 +238,8 @@ class Booster:
         """An UNSTARTED ``PredictionServer`` with this booster registered
         as the ``default`` model (see README "Serving").  Keyword args are
         forwarded (host/port/max_batch_rows/deadline_ms/min_bucket/
-        warmup/max_inflight/telemetry_out)."""
+        warmup/max_inflight/telemetry_out, plus the observability knobs
+        trace/trace_out/trace_capacity/stats_out/stats_interval_s)."""
         from .serving import PredictionServer
 
         return PredictionServer(booster=self, **kwargs)
@@ -291,6 +292,11 @@ def train(params: Dict, train_set: Dataset, num_boost_round: int = 100,
     is identical to an uninterrupted run."""
     params = dict(params or {})
     cfg_probe = Config.from_params(params)
+    if cfg_probe.trace_out and not cfg_probe.telemetry:
+        # spans ride the phase timers, so asking for a trace opts into
+        # telemetry (same implication the CLI applies for --telemetry-out)
+        params["telemetry"] = True
+        cfg_probe = Config.from_params(params)
     if "num_iterations" not in params and num_boost_round is not None:
         params["num_iterations"] = num_boost_round
     num_boost_round = Config.from_params(params).num_iterations
@@ -319,6 +325,14 @@ def train(params: Dict, train_set: Dataset, num_boost_round: int = 100,
         train_set.categorical_feature = categorical_feature
 
     booster = Booster(params=params, train_set=train_set)
+    # structured span recorder (observability/trace.py): host-side only —
+    # attaching it cannot change a traced program, and with trace_out
+    # unset nothing is allocated
+    _tracer = None
+    if cfg_probe.trace_out:
+        from .observability.trace import TraceRecorder
+        _tracer = TraceRecorder(True, capacity=cfg_probe.trace_capacity)
+        booster.gbdt.telemetry.tracer = _tracer
     if init_model is not None:
         init_booster = init_model if isinstance(init_model, Booster) else \
             Booster(model_file=init_model, params=params)
@@ -425,6 +439,15 @@ def train(params: Dict, train_set: Dataset, num_boost_round: int = 100,
     if cfg_probe.telemetry and cfg_probe.telemetry_out:
         from .observability import write_report
         write_report(booster.get_telemetry(), cfg_probe.telemetry_out)
+    if _tracer is not None:
+        # annotate the span timeline with the collective ledger's static
+        # sites (op/phase/cadence/bytes), then write the Chrome JSON
+        ledger = getattr(booster.gbdt.learner, "_ledger", None)
+        if ledger is not None:
+            for site in ledger.sites():
+                _tracer.instant(f"collective:{site['op']}",
+                                cat="collective", args=dict(site))
+        _tracer.save(cfg_probe.trace_out)
     return booster
 
 

@@ -26,14 +26,32 @@ boosting loop and tree learners report through:
     fallbacks, snapshots written/pruned, injected faults) maintained by
     `lightgbm_tpu/reliability/metrics.py`.
 
+  * ``TraceRecorder`` (`trace.py`) — request-scoped structured spans: a
+    thread-safe monotonic-clock ring buffer exporting Chrome trace-event
+    JSON (open in Perfetto).  Training phase timers and the serving
+    queue→pad→bin→traverse→unpad stages land as spans automatically when
+    a recorder is attached (``Telemetry.tracer``); serving requests carry
+    a ``trace_id`` end-to-end so one id links the request span, its
+    micro-batch span and the batch's stage spans.
+  * ``LatencyHistogram`` / Prometheus export (`metrics_export.py`) —
+    log-bucketed latency histograms with exact p50/p95/p99 over a bounded
+    raw-sample window, and the text-format snapshot behind the server's
+    ``metrics`` op.  Schema v4 adds the serving ``latency_ms`` section.
+
 Device-side *time* attribution inside the fused tree program is out of
 scope for counters — that is what the opt-in ``profile_trace_dir``
-(`jax.profiler`) trace is for; see README "Telemetry & profiling".
+(`jax.profiler`) trace is for; see README "Telemetry & profiling" and
+"Tracing & service metrics".
 """
 
 from .collectives import CollectiveLedger
+from .metrics_export import (BENCH_SERVING_SCHEMA, LatencyHistogram,
+                             prometheus_text)
 from .report import load_schema, validate_report, write_report
 from .telemetry import TEL_NAMES, Telemetry
+from .trace import TraceRecorder, new_trace_id
 
 __all__ = ["Telemetry", "CollectiveLedger", "TEL_NAMES",
-           "load_schema", "validate_report", "write_report"]
+           "load_schema", "validate_report", "write_report",
+           "TraceRecorder", "new_trace_id", "LatencyHistogram",
+           "prometheus_text", "BENCH_SERVING_SCHEMA"]

@@ -1,0 +1,271 @@
+"""Latency histograms + Prometheus text-format metrics export.
+
+``ServingStats`` (`serving/batcher.py`) kept aggregate counters only — no
+percentiles, so "p99 latency against an SLO" (ROADMAP item 3) was
+unanswerable.  This module adds:
+
+  * ``LatencyHistogram`` — log-bucketed counts (powers of two from 0.1 ms,
+    the Prometheus ``le`` buckets) PLUS a bounded window of raw samples.
+    Percentiles are extracted from the raw window with numpy's default
+    linear interpolation, so p50/p95/p99 are EXACT over the retained
+    window (``tests/test_tracing.py`` pins equality with ``np.percentile``)
+    rather than bucket-upper-bound approximations; the log buckets exist
+    for the Prometheus exposition, where cumulative buckets are the
+    contract.
+  * ``prometheus_text`` / ``prometheus_snapshot`` — the text exposition
+    format (``# TYPE``, ``_bucket{le=...}``, ``_sum``/``_count``) over the
+    serving counters, stage timers, reliability counters and latency
+    histograms; the server's ``metrics`` op returns this snapshot through
+    the same framed-RPC plumbing as ``health``.
+  * ``BENCH_SERVING_SCHEMA`` — the contract ``bench_serving.py`` validates
+    its ``BENCH_SERVING_r*.json`` trajectory files against (same
+    dependency-free validator subset as ``schema.json``).
+
+Monotonic clocks only; host-side only; every structure is thread-safe and
+lock-leaf (nothing here acquires another subsystem's lock).
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+from collections import deque
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+#: default log buckets: 0.1 ms · 2^k, k = 0..20 (0.1 ms .. ~105 s)
+DEFAULT_BOUNDS_MS: Tuple[float, ...] = tuple(0.1 * (2.0 ** k)
+                                             for k in range(21))
+
+#: raw-sample window backing exact percentiles (per histogram)
+DEFAULT_WINDOW = 8192
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+class LatencyHistogram:
+    """Thread-safe log-bucketed histogram with an exact-percentile window.
+
+    ``record(ms)`` is O(log buckets); ``percentiles`` computes numpy
+    percentiles over the last ``window`` samples (exact for any workload
+    that fits the window, and a sliding-window estimate beyond it — the
+    honest trade for bounded memory in a long-lived server)."""
+
+    def __init__(self, bounds_ms: Optional[Sequence[float]] = None,
+                 window: int = DEFAULT_WINDOW):
+        self.bounds = np.asarray(sorted(bounds_ms if bounds_ms is not None
+                                        else DEFAULT_BOUNDS_MS), np.float64)
+        self._counts = np.zeros(len(self.bounds) + 1, np.int64)  # +Inf last
+        self._window: deque = deque(maxlen=max(int(window), 1))
+        self._lock = threading.Lock()
+        self.count = 0
+        self.sum_ms = 0.0
+        self.max_ms = 0.0
+
+    def record(self, ms: float) -> None:
+        ms = float(ms)
+        # first bound >= ms == the Prometheus `le` bucket the sample joins
+        idx = int(np.searchsorted(self.bounds, ms, side="left"))
+        with self._lock:
+            self._counts[idx] += 1
+            self.count += 1
+            self.sum_ms += ms
+            if ms > self.max_ms:
+                self.max_ms = ms
+            self._window.append(ms)
+
+    # -- extraction ----------------------------------------------------------
+
+    def percentiles(self, qs: Sequence[float] = (50, 95, 99)
+                    ) -> Dict[str, float]:
+        """``{"p50": ..., ...}`` over the raw sample window (numpy linear
+        interpolation — exact vs ``np.percentile`` on the same samples)."""
+        with self._lock:
+            arr = np.asarray(self._window, np.float64)
+        if arr.size == 0:
+            return {f"p{g:g}": 0.0 for g in qs}
+        vals = np.percentile(arr, list(qs))
+        return {f"p{q:g}": float(v) for q, v in zip(qs, vals)}
+
+    def snapshot(self) -> Dict[str, Any]:
+        """The ``latency_ms`` report section (observability/schema.json)."""
+        p = self.percentiles((50, 95, 99))
+        with self._lock:
+            count, total, mx = self.count, self.sum_ms, self.max_ms
+        return {"count": int(count),
+                "mean": float(total / count) if count else 0.0,
+                "max": float(mx),
+                "p50": p["p50"], "p95": p["p95"], "p99": p["p99"]}
+
+    def cumulative_buckets(self) -> List[Tuple[float, int]]:
+        """``(le_ms, cumulative_count)`` rows, ending with ``(inf, count)``."""
+        with self._lock:
+            cum = np.cumsum(self._counts)
+        rows = [(float(b), int(c)) for b, c in zip(self.bounds, cum[:-1])]
+        rows.append((float("inf"), int(cum[-1])))
+        return rows
+
+    def prometheus_lines(self, name: str, labels: str = "") -> List[str]:
+        """Text-exposition histogram block (``le`` in SECONDS, the
+        Prometheus convention for latency metrics)."""
+        name = sanitize_metric_name(name)
+        lab = labels if not labels or labels.startswith("{") else \
+            "{" + labels + "}"
+        base = lab[1:-1] if lab else ""
+        out = [f"# TYPE {name} histogram"]
+        for le_ms, cum in self.cumulative_buckets():
+            le = "+Inf" if le_ms == float("inf") else f"{le_ms / 1e3:g}"
+            sep = "," if base else ""
+            out.append(f'{name}_bucket{{{base}{sep}le="{le}"}} {cum}')
+        with self._lock:
+            out.append(f"{name}_sum{lab} {self.sum_ms / 1e3:g}")
+            out.append(f"{name}_count{lab} {self.count}")
+        return out
+
+
+def sanitize_metric_name(name: str) -> str:
+    """Prometheus metric names allow ``[a-zA-Z0-9_:]`` only."""
+    return _NAME_RE.sub("_", name)
+
+
+def prometheus_text(counters: Optional[Dict[str, float]] = None,
+                    gauges: Optional[Dict[str, float]] = None,
+                    histograms: Optional[Dict[str, LatencyHistogram]] = None,
+                    prefix: str = "lgbt_") -> str:
+    """Render counters/gauges/histograms as one text-format exposition."""
+    lines: List[str] = []
+    for name, v in sorted((counters or {}).items()):
+        n = sanitize_metric_name(prefix + name)
+        lines.append(f"# TYPE {n} counter")
+        lines.append(f"{n} {float(v):g}")
+    for name, v in sorted((gauges or {}).items()):
+        n = sanitize_metric_name(prefix + name)
+        lines.append(f"# TYPE {n} gauge")
+        lines.append(f"{n} {float(v):g}")
+    for name, h in sorted((histograms or {}).items()):
+        lines.extend(h.prometheus_lines(prefix + name))
+    return "\n".join(lines) + "\n"
+
+
+def prometheus_snapshot(stats, registry=None, admission=None) -> str:
+    """The server ``metrics`` op payload: every serving counter, stage
+    timer total, reliability counter, model version and the request
+    latency histogram, as one Prometheus text page."""
+    from ..reliability.metrics import rel_counters
+
+    section = stats.serving_section(
+        models=registry.versions() if registry is not None else None,
+        jit_entries=registry.jit_entries() if registry is not None else None)
+    counters: Dict[str, float] = {
+        "serving_requests_total": section["requests"],
+        "serving_rows_total": section["rows"],
+        "serving_batches_total": section["batches"],
+        "serving_shed_total": section["shed"],
+        "serving_fallback_batches_total": section["fallback_batches"],
+        "serving_compile_cache_hits_total":
+            section["compile_cache"]["hits"],
+        "serving_compile_cache_misses_total":
+            section["compile_cache"]["misses"],
+    }
+    for name, v in rel_counters().items():
+        counters[f"reliability_{sanitize_metric_name(name)}_total"] = v
+    gauges: Dict[str, float] = {
+        "serving_qps": section["qps"],
+        "serving_rows_per_s": section["rows_per_s"],
+        "serving_batch_occupancy": section["batch_occupancy"],
+    }
+    for stage, st in section["stage_ms"].items():
+        g = sanitize_metric_name(stage)
+        gauges[f"serving_stage_{g}_total_seconds"] = st["total_ms"] / 1e3
+        counters[f"serving_stage_{g}_count_total"] = st["count"]
+    if admission is not None:
+        snap = admission.snapshot()
+        gauges["serving_inflight"] = snap["inflight"]
+        gauges["serving_inflight_capacity"] = snap["capacity"]
+        gauges["serving_shedding"] = 1.0 if snap["shedding"] else 0.0
+    if registry is not None:
+        for name, ver in (registry.versions() or {}).items():
+            gauges[f"serving_model_version:{sanitize_metric_name(name)}"] = ver
+    return prometheus_text(
+        counters, gauges,
+        histograms={"serving_request_latency_seconds": stats.request_hist})
+
+
+# -- bench_serving.py contract ------------------------------------------------
+
+_LATENCY_MS_SCHEMA = {
+    "type": "object",
+    "required": ["count", "mean", "max", "p50", "p95", "p99"],
+    "properties": {
+        "count": {"type": "integer"},
+        "mean": {"type": "number"},
+        "max": {"type": "number"},
+        "p50": {"type": "number"},
+        "p95": {"type": "number"},
+        "p99": {"type": "number"},
+    },
+}
+
+_LOOP_SCHEMA = {
+    "type": "object",
+    "required": ["requests", "ok", "shed", "errors", "duration_s", "qps",
+                 "shed_rate", "latency_ms"],
+    "properties": {
+        "requests": {"type": "integer"},
+        "ok": {"type": "integer"},
+        "shed": {"type": "integer"},
+        "errors": {"type": "integer"},
+        "duration_s": {"type": "number"},
+        "qps": {"type": "number"},
+        "shed_rate": {"type": "number"},
+        "latency_ms": _LATENCY_MS_SCHEMA,
+        "clients": {"type": "integer"},
+        "target_qps": {"type": "number"},
+    },
+}
+
+#: the BENCH_SERVING_r*.json contract — the serving analogue of the
+#: training BENCH_r*.json trajectory discipline (validated by
+#: ``observability.report.validate_report`` with this schema)
+BENCH_SERVING_SCHEMA: Dict[str, Any] = {
+    "type": "object",
+    "required": ["schema_version", "round", "platform", "workload",
+                 "closed_loop", "open_loop", "server"],
+    "properties": {
+        "schema_version": {"type": "integer"},
+        "round": {"type": "integer"},
+        "platform": {"type": "string"},
+        "note": {"type": "string"},
+        "workload": {
+            "type": "object",
+            "required": ["num_features", "rows_per_request"],
+            "additionalProperties": {"type": ["number", "string"]},
+        },
+        "closed_loop": _LOOP_SCHEMA,
+        "open_loop": _LOOP_SCHEMA,
+        "server": {
+            "type": "object",
+            "required": ["batches", "batch_occupancy", "shed",
+                         "compile_cache"],
+            "properties": {
+                "batches": {"type": "integer"},
+                "batch_occupancy": {"type": "number"},
+                "shed": {"type": "integer"},
+                "compile_cache": {
+                    "type": "object",
+                    "required": ["hits", "misses"],
+                    "properties": {
+                        "hits": {"type": "integer"},
+                        "misses": {"type": "integer"},
+                        "jit_entries": {"type": ["integer", "null"]},
+                    },
+                },
+                "buckets": {
+                    "type": "object",
+                    "additionalProperties": {"type": "integer"},
+                },
+            },
+        },
+    },
+}

@@ -46,7 +46,9 @@ TEL_NAMES = {
 # sheds, fallbacks, aborts, snapshots, injected faults —
 # `lightgbm_tpu/reliability/metrics.py`); serving section gains
 # shed/fallback counters
-SCHEMA_VERSION = 3
+# v4: serving section gains "latency_ms" (exact p50/p95/p99 from the
+# request latency histogram — `observability/metrics_export.py`)
+SCHEMA_VERSION = 4
 
 
 class Telemetry:
@@ -54,6 +56,11 @@ class Telemetry:
 
     def __init__(self, enabled: bool):
         self.enabled = bool(enabled)
+        # optional span recorder (observability/trace.py): when attached,
+        # every phase occurrence that carries a start stamp also lands as
+        # a trace span, so the Perfetto timeline and the phase table are
+        # two views of the same measurements
+        self.tracer = None
         self._phases: Dict[str, List[float]] = {}  # name -> [sum_s, n, max_s]
         self._counters: Dict[str, int] = {}
         self._gauges: Dict[str, Any] = {}
@@ -74,13 +81,22 @@ class Telemetry:
             return contextlib.nullcontext()
         return _PhaseCtx(self, name)
 
-    def add_phase_time(self, name: str, seconds: float) -> None:
+    def add_phase_time(self, name: str, seconds: float,
+                       t0: Optional[float] = None) -> None:
+        """Accumulate one phase occurrence.  ``t0`` (a ``perf_counter``
+        stamp) additionally records the occurrence as a trace span when a
+        recorder is attached; without it the time lands in the phase
+        table only (some callers measure durations whose start they no
+        longer hold)."""
         if not self.enabled:
             return
         st = self._phases.setdefault(name, [0.0, 0, 0.0])
         st[0] += seconds
         st[1] += 1
         st[2] = max(st[2], seconds)
+        tr = self.tracer
+        if tr is not None and t0 is not None:
+            tr.add_complete(name, t0, seconds, cat="phase")
         if name == "iteration":
             self._iter_total += seconds
             self._iter_count += 1
@@ -207,5 +223,6 @@ class _PhaseCtx:
         return self
 
     def __exit__(self, *exc):
-        self.tel.add_phase_time(self.name, time.perf_counter() - self.t0)
+        self.tel.add_phase_time(self.name, time.perf_counter() - self.t0,
+                                t0=self.t0)
         return False

@@ -34,11 +34,12 @@ _LAZY = {
     "MicroBatcher": "batcher", "ServingStats": "batcher",
     "ModelRegistry": "registry", "ServingModel": "registry",
     "PredictionServer": "server", "ServingClient": "server",
+    "ServerOverloaded": "server",
 }
 
 __all__ = ["OOV_BIN", "BinnerArrays", "MicroBatcher", "ServingStats",
            "ModelRegistry", "ServingModel", "PredictionServer",
-           "ServingClient"]
+           "ServingClient", "ServerOverloaded"]
 
 
 def __getattr__(name):

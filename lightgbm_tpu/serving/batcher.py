@@ -16,6 +16,7 @@ and surfaces in the JSON report's ``serving`` section
 
 from __future__ import annotations
 
+import contextlib
 import queue
 import threading
 import time
@@ -23,7 +24,9 @@ from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
 
-from ..observability import Telemetry
+from ..observability import LatencyHistogram, Telemetry
+
+_NULL_CTX = contextlib.nullcontext()
 
 
 def next_pow2(n: int) -> int:
@@ -54,6 +57,11 @@ class ServingStats:
 
     def __init__(self):
         self.tel = Telemetry(True)
+        # per-request end-to-end latency (admission → response), backing
+        # the serving section's exact p50/p95/p99 and the Prometheus
+        # histogram of the `metrics` op.  Lock-leaf: recorded OUTSIDE
+        # self._lock (metrics_export.LatencyHistogram has its own)
+        self.request_hist = LatencyHistogram()
         self._lock = threading.Lock()
         self._t0 = time.monotonic()
         self.requests = 0
@@ -68,6 +76,16 @@ class ServingStats:
         self.fallback_batches = 0
         self.fallback_rows = 0
 
+    @property
+    def tracer(self):
+        """The attached span recorder (``None`` when tracing is off)."""
+        return self.tel.tracer
+
+    def attach_tracer(self, tracer) -> None:
+        """Attach a ``TraceRecorder``: stage timers double as spans and
+        the batcher emits per-batch / per-request-queue spans."""
+        self.tel.tracer = tracer
+
     def stage(self, name: str):
         return self.tel.phase(f"serve_{name}")
 
@@ -76,8 +94,13 @@ class ServingStats:
             self.requests += 1
             self.rows += int(rows)
 
-    def record_queue_wait(self, seconds: float) -> None:
-        self.tel.add_phase_time("serve_queue", seconds)
+    def record_request_latency(self, ms: float) -> None:
+        """End-to-end server-side request latency (admission→response)."""
+        self.request_hist.record(ms)
+
+    def record_queue_wait(self, seconds: float,
+                          t0: Optional[float] = None) -> None:
+        self.tel.add_phase_time("serve_queue", seconds, t0=t0)
 
     def record_batch(self, bucket: int, rows: int) -> None:
         with self._lock:
@@ -108,6 +131,9 @@ class ServingStats:
 
     def serving_section(self, models: Optional[Dict[str, int]] = None,
                         jit_entries: Optional[int] = None) -> Dict[str, Any]:
+        # histogram snapshot BEFORE self._lock: the histogram's own lock
+        # stays leaf (no nested acquisition for the race detector to chew)
+        latency = self.request_hist.snapshot()
         with self._lock:
             elapsed = max(time.monotonic() - self._t0, 1e-9)
             stage_ms = {}
@@ -134,6 +160,7 @@ class ServingStats:
                 "shed": self.shed,
                 "fallback_batches": self.fallback_batches,
                 "fallback_rows": self.fallback_rows,
+                "latency_ms": latency,
             }
 
     def report(self, models: Optional[Dict[str, int]] = None,
@@ -146,15 +173,18 @@ class ServingStats:
 
 
 class _Request:
-    __slots__ = ("X", "n", "done", "result", "error", "t_enq")
+    __slots__ = ("X", "n", "done", "result", "error", "t_enq", "trace_id")
 
-    def __init__(self, X: np.ndarray):
+    def __init__(self, X: np.ndarray, trace_id: Optional[str] = None):
         self.X = X
         self.n = X.shape[0]
         self.done = threading.Event()
         self.result = None
         self.error: Optional[BaseException] = None
-        self.t_enq = time.monotonic()
+        # perf_counter: the clock the trace recorder's epoch is on, so
+        # the queue-wait span aligns with the stage spans
+        self.t_enq = time.perf_counter()
+        self.trace_id = trace_id
 
 
 class MicroBatcher:
@@ -206,20 +236,22 @@ class MicroBatcher:
 
     # -- request side (any thread) ------------------------------------------
 
-    def submit(self, X: np.ndarray, timeout: Optional[float] = None
-               ) -> np.ndarray:
+    def submit(self, X: np.ndarray, timeout: Optional[float] = None,
+               trace_id: Optional[str] = None) -> np.ndarray:
         """Blocking predict; rows of oversized requests are chunked to the
-        batch budget and re-concatenated."""
+        batch budget and re-concatenated.  ``trace_id`` rides the request
+        into the batch worker so its queue-wait and micro-batch spans
+        link back to the originating request."""
         X = np.ascontiguousarray(np.atleast_2d(np.asarray(X, np.float64)))
         if X.shape[1] != self.num_features:
             raise ValueError(f"request has {X.shape[1]} features, model "
                              f"expects {self.num_features}")
         if X.shape[0] > self.max_rows:
-            parts = [self.submit(X[i:i + self.max_rows], timeout)
+            parts = [self.submit(X[i:i + self.max_rows], timeout, trace_id)
                      for i in range(0, X.shape[0], self.max_rows)]
             return np.concatenate(parts, axis=0)
         self.stats.record_request(X.shape[0])
-        req = _Request(X)
+        req = _Request(X, trace_id=trace_id)
         self._q.put(req)
         if not req.done.wait(timeout):
             raise TimeoutError("prediction request timed out in the "
@@ -263,26 +295,43 @@ class MicroBatcher:
                 self._run_batch(group)
 
     def _run_batch(self, reqs: List[_Request]) -> None:
-        t_start = time.monotonic()
+        t_start = time.perf_counter()
+        tracer = self.stats.tracer
         for r in reqs:
-            self.stats.record_queue_wait(t_start - r.t_enq)
+            # one queue-wait span per rider, carrying ITS trace_id
+            with (tracer.bind(r.trace_id) if tracer is not None
+                  else _NULL_CTX):
+                self.stats.record_queue_wait(t_start - r.t_enq, t0=r.t_enq)
         m = sum(r.n for r in reqs)
         bucket = max(self.min_bucket, next_pow2(m))
+        # the micro-batch span carries EVERY rider's trace_id, and the
+        # bind makes the stage spans recorded inside (pad here,
+        # bin/traverse/unpad in ServingModel.predict_padded) inherit the
+        # same ids — the request→batch→stage causal link
+        ids = [r.trace_id for r in reqs if r.trace_id]
+        span = bind = _NULL_CTX
+        if tracer is not None:
+            span = tracer.span("serve.batch", cat="serving",
+                               trace_id=ids or None,
+                               args={"bucket": int(bucket), "rows": int(m),
+                                     "requests": len(reqs)})
+            bind = tracer.bind(ids or None)
         try:
-            with self.stats.stage("pad"):
-                Xpad = np.zeros((bucket, self.num_features), np.float64)
-                ofs = 0
-                for r in reqs:
-                    Xpad[ofs:ofs + r.n] = r.X
-                    ofs += r.n
-            try:
-                scores = self.predict_fn(Xpad, m)
-            except BaseException:
-                if self.fallback_fn is None:
-                    raise
-                with self.stats.stage("fallback"):
-                    scores = self.fallback_fn(Xpad, m)
-                self.stats.record_fallback(m)
+            with span, bind:
+                with self.stats.stage("pad"):
+                    Xpad = np.zeros((bucket, self.num_features), np.float64)
+                    ofs = 0
+                    for r in reqs:
+                        Xpad[ofs:ofs + r.n] = r.X
+                        ofs += r.n
+                try:
+                    scores = self.predict_fn(Xpad, m)
+                except BaseException:
+                    if self.fallback_fn is None:
+                        raise
+                    with self.stats.stage("fallback"):
+                        scores = self.fallback_fn(Xpad, m)
+                    self.stats.record_fallback(m)
             ofs = 0
             for r in reqs:
                 r.result = scores[ofs:ofs + r.n]

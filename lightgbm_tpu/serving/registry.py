@@ -16,11 +16,14 @@ changes nothing — rollback is the absence of the swap.
 
 from __future__ import annotations
 
+import contextlib
 import threading
 from typing import Dict, List, Optional, Sequence
 
 import jax.numpy as jnp
 import numpy as np
+
+_NULL_CTX = contextlib.nullcontext()
 
 from .batcher import ServingStats, bucket_ladder, next_pow2
 from .binner import BinnerArrays
@@ -151,10 +154,19 @@ class ModelRegistry:
         with self._lock:
             version = self._models[name].version + 1 \
                 if name in self._models else 1
-        model = ServingModel(booster, self.stats, name, version)
-        if self.warmup and self.warm_buckets:
-            model.warm(self.warm_buckets)
-        self._verify(model)
+        tr = self.stats.tracer
+        with (tr.span("serve.swap", cat="serving",
+                      args={"model": name, "version": version})
+              if tr is not None else _NULL_CTX):
+            model = ServingModel(booster, self.stats, name, version)
+            if self.warmup and self.warm_buckets:
+                with (tr.span("serve.warm", cat="serving",
+                              args={"buckets": list(self.warm_buckets)})
+                      if tr is not None else _NULL_CTX):
+                    model.warm(self.warm_buckets)
+            with (tr.span("serve.verify", cat="serving")
+                  if tr is not None else _NULL_CTX):
+                self._verify(model)
         with self._lock:
             self._models[name] = model
         return model.version

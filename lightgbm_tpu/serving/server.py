@@ -9,11 +9,18 @@ clients coalesce into shared device batches.
 
 Ops (dict in, dict out; ``{"ok": False, "error": ...}`` on failure):
 
-  * ``predict``  — ``{"op", "model", "data": ndarray, "raw_score"}`` →
-    ``{"ok": True, "scores": ndarray}``
+  * ``predict``  — ``{"op", "model", "data": ndarray, "raw_score",
+    "trace_id"?}`` → ``{"ok": True, "scores": ndarray, "trace_id"?}``; the
+    (client-supplied or, when tracing, server-generated) ``trace_id`` is
+    echoed back and carried through the batcher so the request span, its
+    micro-batch span and the batch's stage spans share one id
   * ``swap``     — ``{"op", "model", "model_str"}`` → load/verify/hot-swap
     a new model text; the old version serves until the swap commits
-  * ``stats``    — full telemetry report (``serving`` schema section)
+  * ``stats``    — full telemetry report (``serving`` schema section,
+    including exact p50/p95/p99 request latency)
+  * ``metrics``  — Prometheus text-format snapshot (counters, stage
+    timers, reliability counters, request-latency histogram) through the
+    same framed-RPC plumbing as ``health``
   * ``health``   — readiness probe, distinct from ``ping`` liveness:
     registered models + admission state (inflight/capacity/shedding);
     accurate under overload
@@ -21,9 +28,16 @@ Ops (dict in, dict out; ``{"ok": False, "error": ...}`` on failure):
 
 Overload never drops a connection: past ``max_inflight`` concurrently
 admitted predicts, requests shed with a structured
-``{"ok": False, "error": "overloaded", "shed": True}`` frame
+``{"ok": False, "error": "overloaded", "shed": True}`` frame that echoes
+the request's ``trace_id`` so clients can correlate rejections
 (`reliability/degrade.py`), and a device-path failure degrades to the
 host numpy traversal instead of erroring the batch (``fallback_fn``).
+
+Operational surfaces beyond the socket: ``stats_out``/``stats_interval_s``
+write periodic atomic (tmp + ``os.replace``) schema-validated stats
+snapshots operators can poll without a connection, and
+``trace=True``/``trace_out`` record request-scoped spans
+(`observability/trace.py`) written as Chrome trace-event JSON on stop.
 
 Start via ``Booster.serve()`` or ``python -m lightgbm_tpu serve
 input_model=model.txt``.
@@ -31,16 +45,35 @@ input_model=model.txt``.
 
 from __future__ import annotations
 
+import contextlib
 import socket
 import threading
+import time
 from typing import Any, Dict, Optional
+
+_NULL_CTX = contextlib.nullcontext()
 
 import numpy as np
 
 from ..io.net import recv_frame, send_frame
+from ..observability.trace import TraceRecorder, new_trace_id
 from ..reliability.degrade import AdmissionController
 from .batcher import MicroBatcher, ServingStats, bucket_ladder
 from .registry import ModelRegistry
+
+
+class ServerOverloaded(RuntimeError):
+    """Raised by ``ServingClient`` on a structured shed frame.  Carries
+    the server's admission state and the request's echoed ``trace_id``
+    so a client can correlate the rejection with its own records."""
+
+    def __init__(self, resp: Dict[str, Any]):
+        super().__init__(
+            f"server overloaded (inflight "
+            f"{resp.get('inflight')}/{resp.get('capacity')})")
+        self.trace_id = resp.get("trace_id")
+        self.inflight = resp.get("inflight")
+        self.capacity = resp.get("capacity")
 
 
 class PredictionServer:
@@ -51,7 +84,9 @@ class PredictionServer:
                  max_batch_rows: int = 256, deadline_ms: float = 2.0,
                  min_bucket: int = 32, warmup: bool = True,
                  telemetry_out: str = "", request_timeout: float = 60.0,
-                 max_inflight: int = 64):
+                 max_inflight: int = 64, trace: bool = False,
+                 trace_out: str = "", trace_capacity: int = 65536,
+                 stats_out: str = "", stats_interval_s: float = 10.0):
         self.host = host
         self.port = int(port)
         self.max_batch_rows = int(max_batch_rows)
@@ -61,6 +96,18 @@ class PredictionServer:
         self.request_timeout = float(request_timeout)
         self.admission = AdmissionController(max_inflight)
         self.stats = ServingStats()
+        # request-scoped tracing: host-side spans only, written as Chrome
+        # trace-event JSON on stop (open in Perfetto)
+        self.trace_out = trace_out
+        self.tracer: Optional[TraceRecorder] = None
+        if trace or trace_out:
+            self.tracer = TraceRecorder(True, capacity=trace_capacity)
+            self.stats.attach_tracer(self.tracer)
+        # periodic atomic schema-validated stats snapshots (poll the file
+        # instead of the socket op)
+        self.stats_out = stats_out
+        self.stats_interval_s = float(stats_interval_s)
+        self._stats_thread: Optional[threading.Thread] = None
         self.buckets = bucket_ladder(min_bucket, max_batch_rows)
         self.registry = registry or ModelRegistry(
             stats=self.stats, warm_buckets=self.buckets, warmup=warmup)
@@ -89,6 +136,10 @@ class PredictionServer:
         self._accept_thread = threading.Thread(
             target=self._accept_loop, name="lgbt-serve-accept", daemon=True)
         self._accept_thread.start()
+        if self.stats_out:
+            self._stats_thread = threading.Thread(
+                target=self._stats_loop, name="lgbt-serve-stats", daemon=True)
+            self._stats_thread.start()
         return self
 
     def stop(self) -> None:
@@ -107,6 +158,10 @@ class PredictionServer:
         if self.telemetry_out:
             from ..observability import write_report
             write_report(self.report(), self.telemetry_out)
+        if self.stats_out:
+            self._write_stats_snapshot()     # final snapshot at shutdown
+        if self.trace_out and self.tracer is not None:
+            self.tracer.save(self.trace_out)
         self._stopped.set()
 
     def wait(self, timeout: Optional[float] = None) -> bool:
@@ -123,6 +178,31 @@ class PredictionServer:
     def report(self) -> Dict[str, Any]:
         return self.stats.report(models=self.registry.versions(),
                                  jit_entries=self.registry.jit_entries())
+
+    def trace(self) -> Optional[Dict[str, Any]]:
+        """The captured Chrome trace-event JSON object (``None`` when
+        tracing is off)."""
+        return self.tracer.export() if self.tracer is not None else None
+
+    def _write_stats_snapshot(self) -> None:
+        from ..observability import write_report
+        try:
+            write_report(self.report(), self.stats_out)
+        except Exception as e:
+            # a full disk or transient schema problem must not kill the
+            # snapshot loop (or serving); the failure is counted so it
+            # still surfaces in the reliability section
+            from ..reliability.metrics import rel_inc
+            rel_inc("serve.stats_snapshot_errors")
+            print(f"[LightGBM-TPU] [Warning] stats snapshot failed: {e}",
+                  flush=True)
+
+    def _stats_loop(self) -> None:
+        """Periodic operator-pollable snapshots: atomic (tmp +
+        ``os.replace`` inside ``write_report``) and schema-validated, so
+        a reader never observes a torn or malformed file."""
+        while not self._stop.wait(self.stats_interval_s):
+            self._write_stats_snapshot()
 
     # -- batching ------------------------------------------------------------
 
@@ -210,24 +290,47 @@ class PredictionServer:
                     "models": models,
                     **self.admission.snapshot()}
         if op == "predict":
+            # the request's causal id: client-supplied, or minted here
+            # when tracing so every request is attributable in the trace
+            trace_id = msg.get("trace_id") or \
+                (new_trace_id() if self.tracer is not None else None)
             # bounded admission: past capacity we answer IMMEDIATELY with
             # a structured shed frame — never a queue-until-timeout that
-            # looks like a dropped connection from the outside
+            # looks like a dropped connection from the outside.  The shed
+            # frame echoes trace_id so the client can correlate the
+            # rejection with its own request records
             if not self.admission.try_acquire():
                 self.stats.record_shed()
-                return {"ok": False, "error": "overloaded", "shed": True,
+                resp = {"ok": False, "error": "overloaded", "shed": True,
                         "inflight": self.admission.inflight,
                         "capacity": self.admission.capacity}
+                if trace_id is not None:
+                    resp["trace_id"] = trace_id
+                return resp
+            t0 = time.perf_counter()
             try:
                 name = msg.get("model", "default")
                 model = self.registry.get(name)
                 X = np.atleast_2d(np.asarray(msg["data"], dtype=np.float64))
-                raw = self._batcher(name).submit(
-                    X, timeout=self.request_timeout)
-                scores = model.convert_output(raw, bool(msg.get("raw_score")))
-                return {"ok": True, "scores": np.asarray(scores)}
+                span = self.tracer.span(
+                    "serve.request", cat="serving", trace_id=trace_id,
+                    args={"model": name, "rows": int(X.shape[0])}) \
+                    if self.tracer is not None else _NULL_CTX
+                with span:
+                    raw = self._batcher(name).submit(
+                        X, timeout=self.request_timeout, trace_id=trace_id)
+                    scores = model.convert_output(raw,
+                                                  bool(msg.get("raw_score")))
+                resp = {"ok": True, "scores": np.asarray(scores)}
+                if trace_id is not None:
+                    resp["trace_id"] = trace_id
+                return resp
             finally:
                 self.admission.release()
+                # admission→response latency, errors included — the p99
+                # an external client actually observes server-side
+                self.stats.record_request_latency(
+                    (time.perf_counter() - t0) * 1e3)
         if op == "swap":
             version = self.registry.load(
                 msg.get("model", "default"), model_str=msg.get("model_str"),
@@ -235,6 +338,16 @@ class PredictionServer:
             return {"ok": True, "version": version}
         if op == "stats":
             return {"ok": True, "report": self.report()}
+        if op == "metrics":
+            # Prometheus text exposition over the same framed-RPC plumbing
+            # as `health` — scrape with `ServingClient.metrics()` or the
+            # CLI; le buckets in seconds, counters monotone
+            from ..observability.metrics_export import prometheus_snapshot
+            return {"ok": True,
+                    "text": prometheus_snapshot(self.stats,
+                                                registry=self.registry,
+                                                admission=self.admission),
+                    "content_type": "text/plain; version=0.0.4"}
         if op == "shutdown":
             # ack first; stop from a side thread (stop() joins batcher
             # threads and must not run on this handler)
@@ -256,6 +369,9 @@ class ServingClient:
             send_frame(self._sock, msg)
             resp = recv_frame(self._sock)
         if not resp.get("ok"):
+            if resp.get("shed"):
+                # structured overload: typed, with the echoed trace_id
+                raise ServerOverloaded(resp)
             raise RuntimeError(f"server error: {resp.get('error')}")
         return resp
 
@@ -266,19 +382,31 @@ class ServingClient:
         """Readiness + admission state (see ``health`` op)."""
         return self._call({"op": "health"})
 
-    def predict(self, X, model: str = "default",
-                raw_score: bool = False) -> np.ndarray:
-        resp = self._call({"op": "predict", "model": model,
-                           "data": np.asarray(X, dtype=np.float64),
-                           "raw_score": raw_score})
-        return resp["scores"]
+    def predict(self, X, model: str = "default", raw_score: bool = False,
+                trace_id: Optional[str] = None) -> np.ndarray:
+        """Blocking predict.  ``trace_id`` (any opaque string, e.g.
+        ``observability.new_trace_id()``) is carried through the server's
+        request/batch/stage spans and echoed in the response — including
+        shed responses, where it lands on ``ServerOverloaded.trace_id``."""
+        msg = {"op": "predict", "model": model,
+               "data": np.asarray(X, dtype=np.float64),
+               "raw_score": raw_score}
+        if trace_id is not None:
+            msg["trace_id"] = trace_id
+        return self._call(msg)["scores"]
 
     def swap(self, model_str: str, model: str = "default") -> int:
         return self._call({"op": "swap", "model": model,
                            "model_str": model_str})["version"]
 
     def stats(self) -> Dict[str, Any]:
+        """Full telemetry report (``serving`` section with exact
+        p50/p95/p99 request latency under ``latency_ms``)."""
         return self._call({"op": "stats"})["report"]
+
+    def metrics(self) -> str:
+        """Prometheus text-format metrics snapshot (see ``metrics`` op)."""
+        return self._call({"op": "metrics"})["text"]
 
     def shutdown(self) -> None:
         self._call({"op": "shutdown"})
