@@ -50,6 +50,8 @@ DEFAULT_FILES = (
     os.path.join("io", "net.py"),
     os.path.join("reliability", "degrade.py"),
     os.path.join("reliability", "metrics.py"),
+    os.path.join("lifecycle", "recorder.py"),
+    os.path.join("lifecycle", "controller.py"),
 )
 
 _LOCK_FACTORIES = {"Lock", "RLock", "Condition", "Semaphore",

@@ -206,12 +206,16 @@ def run_serve(params: Dict[str, str], cfg: Config) -> None:
         telemetry_out=cfg.telemetry_out,
         trace_out=cfg.trace_out, trace_capacity=cfg.trace_capacity,
         stats_out=cfg.serve_stats_out,
-        stats_interval_s=cfg.serve_stats_interval)
+        stats_interval_s=cfg.serve_stats_interval,
+        record_rows=cfg.lifecycle_record_rows)
     _log(f"Serving {cfg.input_model} at {server.host}:{server.port} "
          f"(buckets {server.buckets}, deadline {cfg.serve_deadline_ms} ms)")
     if cfg.serve_stats_out:
         _log(f"Stats snapshots every {cfg.serve_stats_interval:g}s to "
              f"{cfg.serve_stats_out}")
+    if cfg.lifecycle_record_rows > 0:
+        _log(f"Recording the newest {cfg.lifecycle_record_rows} request "
+             f"rows for lifecycle shadow validation")
     try:
         server.wait()
     except KeyboardInterrupt:

@@ -445,6 +445,34 @@ class Config:
     # (aliases: stats_out / stats_interval)
     serve_stats_out: str = ""
     serve_stats_interval: float = 10.0
+    # --- lifecycle (lightgbm_tpu/lifecycle/) ---
+    # bounded live-traffic ring in the serving server: the newest this
+    # many request feature rows are retained for the lifecycle shadow
+    # replay (0 = recording off; memory is capacity x features x 8B)
+    lifecycle_record_rows: int = 0
+    # shadow metric floor gate: metric name ("auc", "l2",
+    # "binary_logloss"; "" = gate off) and the floor the CANDIDATE must
+    # clear on labeled shadow data (NaN = gate off)
+    lifecycle_metric: str = ""
+    lifecycle_metric_floor: float = float("nan")
+    # shadow divergence ceiling: mean |candidate - incumbent| over the
+    # replayed predictions (output space) must stay under this
+    lifecycle_divergence_max: float = 0.25
+    # shadow latency ceiling: candidate per-batch p50 may be at most this
+    # multiple of the incumbent's p50 from the same replay
+    lifecycle_latency_max_ratio: float = 4.0
+    # smallest recording the shadow gates accept (fewer rows = reject:
+    # an unjudgeable candidate is not a promotable candidate)
+    lifecycle_min_shadow_rows: int = 1
+    # post-promotion circuit breaker: watch serving health for this many
+    # seconds, sampling every watch_interval; breaching the error/
+    # fallback rate (error_rate_max, per request/batch) or the shed rate
+    # (shed_rate_max, per offered request) auto-rolls-back to the
+    # retained incumbent
+    lifecycle_rollback_deadline_s: float = 30.0
+    lifecycle_watch_interval_s: float = 0.5
+    lifecycle_error_rate_max: float = 0.05
+    lifecycle_shed_rate_max: float = 0.5
     # replay stall correction batch: when the exact greedy replay reaches
     # a leaf the speculative growth never split, split up to this many of
     # the highest-priority unsplit frontier leaves in ONE correction pass

@@ -238,8 +238,9 @@ class Booster:
         """An UNSTARTED ``PredictionServer`` with this booster registered
         as the ``default`` model (see README "Serving").  Keyword args are
         forwarded (host/port/max_batch_rows/deadline_ms/min_bucket/
-        warmup/max_inflight/telemetry_out, plus the observability knobs
-        trace/trace_out/trace_capacity/stats_out/stats_interval_s)."""
+        warmup/max_inflight/telemetry_out, the observability knobs
+        trace/trace_out/trace_capacity/stats_out/stats_interval_s, and
+        the lifecycle traffic-ring capacity record_rows)."""
         from .serving import PredictionServer
 
         return PredictionServer(booster=self, **kwargs)
@@ -289,7 +290,12 @@ def train(params: Dict, train_set: Dataset, num_boost_round: int = 100,
     retention — `reliability/resume.py`), and ``resume=True`` (or config
     ``resume``/CLI ``--resume``) continues a killed run from the newest
     valid snapshot, training only the REMAINING iterations so the result
-    is identical to an uninterrupted run."""
+    is identical to an uninterrupted run.  Resume composes with
+    ``init_model`` continued training (the lifecycle refit path): a
+    snapshot NEWER than the incumbent wins — it already embeds the
+    incumbent's trees — and the run still targets the original total of
+    incumbent iterations + ``num_boost_round``; with no (or an older)
+    snapshot the incumbent warm-starts as usual."""
     params = dict(params or {})
     cfg_probe = Config.from_params(params)
     if cfg_probe.trace_out and not cfg_probe.telemetry:
@@ -306,16 +312,32 @@ def train(params: Dict, train_set: Dataset, num_boost_round: int = 100,
         from .reliability import faults
         faults.arm(cfg_probe.fault_spec)
 
-    # crash-safe resume: newest valid snapshot becomes the init model and
-    # num_boost_round stays the TOTAL target, not an increment
+    # warm start: an init_model (continued training / refit) seeds the
+    # incumbent's trees and replayed scores before boosting continues on
+    # the fresh data.  Loaded up front so the crash-safe resume decision
+    # below can compare snapshot iterations against the incumbent's.
+    init_booster: Optional[Booster] = None
+    resume_base_iter = 0
+    if init_model is not None:
+        init_booster = init_model if isinstance(init_model, Booster) else \
+            Booster(model_file=init_model, params=params)
+        resume_base_iter = init_booster.current_iteration
+
+    # crash-safe resume: the newest valid snapshot becomes the init model.
+    # Composes with init_model (a refit killed mid-run): the snapshot
+    # already EMBEDS the incumbent's trees, so it wins whenever it is
+    # newer than the incumbent, and the round target stays the original
+    # refit's total (incumbent iterations + num_boost_round)
     resumed_iter: Optional[int] = None
-    if (resume if resume is not None else cfg_probe.resume) \
-            and init_model is None:
+    snapshot_state_path: Optional[str] = None
+    if (resume if resume is not None else cfg_probe.resume):
         from .reliability.metrics import rel_inc
         from .reliability.resume import find_resume_snapshot
         found = find_resume_snapshot(cfg_probe.output_model, cfg_probe)
-        if found is not None:
-            resumed_iter, init_model = found
+        if found is not None and found[0] > resume_base_iter:
+            resumed_iter, snapshot_state_path = found
+            init_booster = Booster(model_file=snapshot_state_path,
+                                   params=params)
             rel_inc("resume_runs")
 
     train_set.params = {**params, **(train_set.params or {})}
@@ -333,18 +355,16 @@ def train(params: Dict, train_set: Dataset, num_boost_round: int = 100,
         from .observability.trace import TraceRecorder
         _tracer = TraceRecorder(True, capacity=cfg_probe.trace_capacity)
         booster.gbdt.telemetry.tracer = _tracer
-    if init_model is not None:
-        init_booster = init_model if isinstance(init_model, Booster) else \
-            Booster(model_file=init_model, params=params)
+    if init_booster is not None:
         _continue_training(booster, init_booster)
-        if resumed_iter is not None and isinstance(init_model, str):
+        if snapshot_state_path is not None:
             # exact continuation: the state sidecar restores the LIVE
             # float32 score array and RNG streams, making the resumed
             # run bit-identical to an uninterrupted one (the traversal
             # replay above is a ulp-level approximation of it)
             from .reliability.resume import (load_snapshot_state,
                                              restore_training_state)
-            state = load_snapshot_state(init_model)
+            state = load_snapshot_state(snapshot_state_path)
             if state is not None:
                 restore_training_state(booster.gbdt, state)
 
@@ -379,10 +399,12 @@ def train(params: Dict, train_set: Dataset, num_boost_round: int = 100,
     callbacks_after.sort(key=lambda cb: getattr(cb, "order", 0))
 
     init_iter = booster.current_iteration
-    # resumed runs train to the configured TOTAL; init_model continuation
-    # keeps the reference's "N more rounds" semantics
+    # resumed runs train to the ORIGINAL target — the incumbent's
+    # iterations (0 for a from-scratch run) plus the requested rounds —
+    # while init_model continuation keeps the reference's "N more
+    # rounds" semantics
     end_iter = init_iter + num_boost_round if resumed_iter is None \
-        else max(num_boost_round, init_iter)
+        else max(resume_base_iter + num_boost_round, init_iter)
     snapshot_freq = cfg_probe.snapshot_freq
     evaluation_result_list: List[Tuple] = []
     # opt-in jax.profiler device trace around the training loop — real
@@ -410,6 +432,13 @@ def train(params: Dict, train_set: Dataset, num_boost_round: int = 100,
             from .reliability.resume import save_snapshot
             save_snapshot(booster.gbdt, cfg_probe.output_model, i + 1,
                           cfg_probe)
+        # chaos seam: `train.crash[:nth=K]` kills the run after its K-th
+        # completed iteration (snapshot, if due, already written) so the
+        # lifecycle tests exercise the REAL kill-mid-refit → resume path
+        from .reliability import faults as _faults
+        if _faults.fire("train.crash") is not None:
+            raise RuntimeError(
+                f"injected fault train.crash at iteration {i + 1}")
         evaluation_result_list = []
         if booster.gbdt.valid_metrics or booster.gbdt.training_metrics or feval:
             if booster.gbdt.training_metrics or (

@@ -1,0 +1,27 @@
+"""Continuous train→serve lifecycle (ROADMAP item 4).
+
+The trainer and the server stop being two disconnected programs: a
+refreshed model is trained INCREMENTALLY off the live incumbent
+(``engine.train(init_model=...)`` riding the crash-safe snapshot
+machinery), judged against RECORDED live traffic (shadow replay with
+divergence / metric / latency gates), and atomically promoted through
+the serving registry with the incumbent retained — a post-promotion
+watchdog rolls back automatically when serving health regresses.
+
+  * ``recorder``   — bounded ring capture of served feature rows
+  * ``shadow``     — gated candidate-vs-incumbent replay
+  * ``controller`` — ``LifecycleController``: refit → shadow → promote →
+    watch, with every decision in the ``lifecycle`` telemetry section
+
+Chaos-testable end to end: ``train.crash`` kills a refit mid-run (resume
+is bit-identical), ``serve.predict.fail`` after a promotion drives the
+watchdog's automatic rollback (`tests/test_lifecycle.py`).
+"""
+
+from .controller import (CandidateRejected, LifecycleController,
+                         RollbackWatchdog)
+from .recorder import TrafficRecorder
+from .shadow import shadow_validate
+
+__all__ = ["LifecycleController", "RollbackWatchdog", "CandidateRejected",
+           "TrafficRecorder", "shadow_validate"]

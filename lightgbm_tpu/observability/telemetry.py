@@ -48,7 +48,11 @@ TEL_NAMES = {
 # shed/fallback counters
 # v4: serving section gains "latency_ms" (exact p50/p95/p99 from the
 # request latency histogram — `observability/metrics_export.py`)
-SCHEMA_VERSION = 4
+# v5: optional "lifecycle" section (promotions / rollbacks / shadow
+# reports / watchdog state — `lightgbm_tpu/lifecycle/controller.py`);
+# serving section gains "errors" (admitted requests answered with an
+# error frame)
+SCHEMA_VERSION = 5
 
 
 class Telemetry:

@@ -18,6 +18,9 @@ Spec grammar (semicolon-separated clauses)::
     net.crash:rank=1:nth=2            # rank 1 hard-exits at its 2nd collective
     serve.predict.fail:count=-1       # every device predict raises
     serve.predict.delay:seconds=0.2   # device predict stalls (overload tests)
+    train.crash:nth=3                 # kill training after its 3rd iteration
+                                      # (snapshots already written — the
+                                      # lifecycle kill-mid-refit seam)
 
 Clause keys understood everywhere: ``rank`` (only fire for that rank;
 default any), ``nth`` (first firing hit, 1-based, counted per clause over

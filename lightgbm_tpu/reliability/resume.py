@@ -47,6 +47,13 @@ _VOLATILE_KEYS = frozenset({
     "net_max_frame_mb", "net_collective_deadline_s",
     "serve_host", "serve_port", "serve_max_batch_rows", "serve_deadline_ms",
     "serve_min_bucket", "serve_warmup", "serve_max_inflight",
+    "serve_stats_out", "serve_stats_interval",
+    "trace_out", "trace_capacity",
+    "lifecycle_record_rows", "lifecycle_metric", "lifecycle_metric_floor",
+    "lifecycle_divergence_max", "lifecycle_latency_max_ratio",
+    "lifecycle_min_shadow_rows", "lifecycle_rollback_deadline_s",
+    "lifecycle_watch_interval_s", "lifecycle_error_rate_max",
+    "lifecycle_shed_rate_max",
     "is_parallel", "is_parallel_find_bin", "_FIELD_TYPES",
 })
 
@@ -135,6 +142,38 @@ def restore_training_state(gbdt, state: Dict[str, Any]) -> bool:
     return True
 
 
+def _validate(path: str,
+              fingerprint: Optional[str] = None) -> Tuple[bool, str, str]:
+    """(ok, kind, reason) — ``kind`` is the machine-readable rejection
+    class (``unreadable`` / ``truncated`` / ``sidecar_unreadable`` /
+    ``fingerprint_mismatch``) the reliability counters key on."""
+    try:
+        with open(path) as fh:
+            text = fh.read()
+    except OSError as e:
+        return False, "unreadable", f"unreadable: {e}"
+    if "end of trees" not in text:
+        return False, "truncated", \
+            "truncated model text (no 'end of trees' trailer)"
+    meta_path = path + META_SUFFIX
+    if fingerprint is not None:
+        if os.path.exists(meta_path):
+            try:
+                with open(meta_path) as fh:
+                    meta = json.load(fh)
+            except (OSError, ValueError) as e:
+                return False, "sidecar_unreadable", f"unreadable sidecar: {e}"
+            got = meta.get("config_fingerprint")
+            if got != fingerprint:
+                return False, "fingerprint_mismatch", \
+                    (f"config fingerprint mismatch (snapshot "
+                     f"{got}, current {fingerprint})")
+        else:
+            warnings.warn(f"snapshot {path} has no metadata sidecar; "
+                          f"resuming without a config-fingerprint check")
+    return True, "ok", "ok"
+
+
 def validate_snapshot(path: str,
                       fingerprint: Optional[str] = None) -> Tuple[bool, str]:
     """(ok, reason).  A snapshot is valid when the model text is complete
@@ -143,45 +182,28 @@ def validate_snapshot(path: str,
     and, when a ``fingerprint`` is given and a sidecar exists, the sidecar
     fingerprint matches.  A missing sidecar is accepted with a warning —
     pre-sidecar snapshots stay resumable."""
-    try:
-        with open(path) as fh:
-            text = fh.read()
-    except OSError as e:
-        return False, f"unreadable: {e}"
-    if "end of trees" not in text:
-        return False, "truncated model text (no 'end of trees' trailer)"
-    meta_path = path + META_SUFFIX
-    if fingerprint is not None:
-        if os.path.exists(meta_path):
-            try:
-                with open(meta_path) as fh:
-                    meta = json.load(fh)
-            except (OSError, ValueError) as e:
-                return False, f"unreadable sidecar: {e}"
-            got = meta.get("config_fingerprint")
-            if got != fingerprint:
-                return False, (f"config fingerprint mismatch (snapshot "
-                               f"{got}, current {fingerprint})")
-        else:
-            warnings.warn(f"snapshot {path} has no metadata sidecar; "
-                          f"resuming without a config-fingerprint check")
-    return True, "ok"
+    ok, _kind, reason = _validate(path, fingerprint)
+    return ok, reason
 
 
 def find_resume_snapshot(output_model: str,
                          cfg=None) -> Optional[Tuple[int, str]]:
     """Newest valid snapshot for ``output_model`` as (iteration, path), or
     ``None``.  Invalid candidates are skipped newest-first with a warning
-    naming the reason."""
+    naming the reason, and each rejection is CLASSIFIED into the
+    reliability counters (``snapshots_rejected.<kind>`` — fingerprint
+    mismatch vs truncation vs unreadable) so a post-mortem can tell a
+    config drift from disk corruption without scraping warnings."""
     if not output_model:
         return None
     fp = config_fingerprint(cfg) if cfg is not None else None
     for iteration, path in reversed(list_snapshots(output_model)):
-        ok, reason = validate_snapshot(path, fp)
+        ok, kind, reason = _validate(path, fp)
         if ok:
             return iteration, path
         warnings.warn(f"skipping snapshot {path}: {reason}")
         rel_inc("snapshots_rejected")
+        rel_inc(f"snapshots_rejected.{kind}")
     return None
 
 

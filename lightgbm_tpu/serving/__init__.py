@@ -34,12 +34,12 @@ _LAZY = {
     "MicroBatcher": "batcher", "ServingStats": "batcher",
     "ModelRegistry": "registry", "ServingModel": "registry",
     "PredictionServer": "server", "ServingClient": "server",
-    "ServerOverloaded": "server",
+    "ServerOverloaded": "server", "ServerUnavailable": "server",
 }
 
 __all__ = ["OOV_BIN", "BinnerArrays", "MicroBatcher", "ServingStats",
            "ModelRegistry", "ServingModel", "PredictionServer",
-           "ServingClient", "ServerOverloaded"]
+           "ServingClient", "ServerOverloaded", "ServerUnavailable"]
 
 
 def __getattr__(name):

@@ -73,6 +73,7 @@ class ServingStats:
         self.cache_hits = 0
         self.cache_misses = 0
         self.shed = 0
+        self.errors = 0
         self.fallback_batches = 0
         self.fallback_rows = 0
 
@@ -121,6 +122,15 @@ class ServingStats:
         with self._lock:
             self.shed += 1
 
+    def record_error(self) -> None:
+        """An admitted predict request that answered with an error frame
+        — the signal the lifecycle rollback watchdog rates promotions
+        by (`lifecycle/controller.py`)."""
+        from ..reliability.metrics import rel_inc
+        with self._lock:
+            self.errors += 1
+        rel_inc("serve.request_errors")
+
     def record_fallback(self, rows: int) -> None:
         from ..reliability.metrics import rel_inc
         with self._lock:
@@ -158,6 +168,7 @@ class ServingStats:
                             for b, c in sorted(self.bucket_batches.items())},
                 "models": dict(models or {}),
                 "shed": self.shed,
+                "errors": self.errors,
                 "fallback_batches": self.fallback_batches,
                 "fallback_rows": self.fallback_rows,
                 "latency_ms": latency,

@@ -7,11 +7,14 @@ WITH training data serve in their training bin space; text-loaded boosters
 serve through the reconstructed schema (`predictor.reconstruct_bin_schema`)
 — the loaded-model host-path caveat is gone.
 
-``ModelRegistry.load`` builds, warms and VERIFIES a candidate (device
+``ModelRegistry.prepare`` builds, warms and VERIFIES a candidate (device
 scores vs the host reference traversal on a fuzz sample) entirely off to
-the side; only a candidate that passes is swapped in, under the registry
-lock, while the previous version keeps serving.  A failed load raises and
-changes nothing — rollback is the absence of the swap.
+the side — the lifecycle loop's shadow validation replays exactly this
+prepared-but-never-swapped object.  ``commit`` performs the atomic swap
+under the registry lock while RETAINING the displaced incumbent, so
+``rollback`` can re-swap it back (the lifecycle watchdog's automatic
+recovery); ``load`` is prepare+commit.  A failed prepare raises and
+changes nothing — rejection is the absence of the swap.
 """
 
 from __future__ import annotations
@@ -138,15 +141,19 @@ class ModelRegistry:
         self.verify_tol = float(verify_tol)
         self._lock = threading.Lock()
         self._models: Dict[str, ServingModel] = {}
+        # the version each commit displaced, retained per name so
+        # rollback() can re-swap it (lifecycle auto-rollback)
+        self._previous: Dict[str, ServingModel] = {}
 
-    # -- load / verify / swap ------------------------------------------------
+    # -- prepare / commit (load = both) --------------------------------------
 
-    def load(self, name: str = "default", booster=None,
-             model_str: Optional[str] = None,
-             model_file: Optional[str] = None) -> int:
-        """Build, warm and verify a candidate, then atomically swap it in.
-        On any failure the exception propagates and the previous version
-        keeps serving untouched."""
+    def prepare(self, name: str = "default", booster=None,
+                model_str: Optional[str] = None,
+                model_file: Optional[str] = None) -> ServingModel:
+        """Build, warm and verify a candidate WITHOUT swapping it in —
+        the serving path never sees it.  The lifecycle shadow loop
+        replays this object; ``commit`` makes it live.  On any failure
+        the exception propagates and nothing changed."""
         if booster is None:
             from ..engine import Booster
             booster = Booster(model_str=model_str) if model_str is not None \
@@ -155,21 +162,67 @@ class ModelRegistry:
             version = self._models[name].version + 1 \
                 if name in self._models else 1
         tr = self.stats.tracer
-        with (tr.span("serve.swap", cat="serving",
-                      args={"model": name, "version": version})
-              if tr is not None else _NULL_CTX):
-            model = ServingModel(booster, self.stats, name, version)
-            if self.warmup and self.warm_buckets:
-                with (tr.span("serve.warm", cat="serving",
-                              args={"buckets": list(self.warm_buckets)})
-                      if tr is not None else _NULL_CTX):
-                    model.warm(self.warm_buckets)
-            with (tr.span("serve.verify", cat="serving")
+        model = ServingModel(booster, self.stats, name, version)
+        if self.warmup and self.warm_buckets:
+            with (tr.span("serve.warm", cat="serving",
+                          args={"buckets": list(self.warm_buckets)})
                   if tr is not None else _NULL_CTX):
-                self._verify(model)
-        with self._lock:
-            self._models[name] = model
+                model.warm(self.warm_buckets)
+        with (tr.span("serve.verify", cat="serving")
+              if tr is not None else _NULL_CTX):
+            self._verify(model)
+        return model
+
+    def commit(self, model: ServingModel) -> int:
+        """Atomically swap a prepared candidate in, retaining the
+        displaced incumbent for ``rollback``."""
+        tr = self.stats.tracer
+        with (tr.span("serve.swap", cat="serving",
+                      args={"model": model.name, "version": model.version})
+              if tr is not None else _NULL_CTX):
+            with self._lock:
+                old = self._models.get(model.name)
+                # re-number against the live version (another commit may
+                # have landed since prepare)
+                model.version = old.version + 1 if old is not None else \
+                    max(model.version, 1)
+                if old is not None:
+                    self._previous[model.name] = old
+                self._models[model.name] = model
         return model.version
+
+    def load(self, name: str = "default", booster=None,
+             model_str: Optional[str] = None,
+             model_file: Optional[str] = None) -> int:
+        """Build, warm and verify a candidate, then atomically swap it in.
+        On any failure the exception propagates and the previous version
+        keeps serving untouched."""
+        return self.commit(self.prepare(name, booster=booster,
+                                        model_str=model_str,
+                                        model_file=model_file))
+
+    def rollback(self, name: str = "default") -> int:
+        """Re-swap the retained previous version in (the displaced
+        current version becomes the new retained one, so a mistaken
+        rollback is itself reversible).  Raises ``KeyError`` when no
+        previous version is retained."""
+        from ..reliability.metrics import rel_inc
+        tr = self.stats.tracer
+        with self._lock:
+            prev = self._previous.get(name)
+            if prev is None:
+                raise KeyError(f"no previous version retained for "
+                               f"model {name!r}")
+            cur = self._models[name]
+            self._models[name] = prev
+            self._previous[name] = cur
+            restored = prev.version
+        rel_inc("serve.rollbacks")
+        if tr is not None:
+            tr.instant("serve.rollback", cat="serving",
+                       args={"model": name, "restored": restored,
+                             "displaced": cur.version})
+        return restored
 
     def _verify(self, model: ServingModel) -> None:
         """Device scores vs the host reference traversal on a fuzz sample
@@ -211,6 +264,16 @@ class ModelRegistry:
     def versions(self) -> Dict[str, int]:
         with self._lock:
             return {n: m.version for n, m in self._models.items()}
+
+    def versions_detail(self) -> Dict[str, Dict[str, Optional[int]]]:
+        """Per-name serving + retained-previous versions — the operator
+        view the ``health`` op exposes, so "what is serving and what
+        would a rollback restore" is answerable without logs."""
+        with self._lock:
+            return {n: {"version": m.version,
+                        "previous": (self._previous[n].version
+                                     if n in self._previous else None)}
+                    for n, m in self._models.items()}
 
     def jit_entries(self) -> Optional[int]:
         with self._lock:

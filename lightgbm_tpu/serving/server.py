@@ -56,6 +56,7 @@ _NULL_CTX = contextlib.nullcontext()
 import numpy as np
 
 from ..io.net import recv_frame, send_frame
+from ..lifecycle.recorder import TrafficRecorder
 from ..observability.trace import TraceRecorder, new_trace_id
 from ..reliability.degrade import AdmissionController
 from .batcher import MicroBatcher, ServingStats, bucket_ladder
@@ -76,6 +77,21 @@ class ServerOverloaded(RuntimeError):
         self.capacity = resp.get("capacity")
 
 
+class ServerUnavailable(ConnectionError):
+    """Raised by ``ServingClient`` when the transport retry budget is
+    exhausted (connect or send/recv kept failing).  A ``ConnectionError``
+    subclass, so callers that already handle transport failures keep
+    working; distinct from ``ServerOverloaded``, which is a STRUCTURED
+    server decision and is never retried blindly."""
+
+    def __init__(self, attempts: int, last: BaseException):
+        super().__init__(
+            f"server unavailable after {attempts} attempt(s): "
+            f"{type(last).__name__}: {last}")
+        self.attempts = attempts
+        self.last_error = last
+
+
 class PredictionServer:
     """Long-lived serving process state: registry + batchers + listener."""
 
@@ -86,7 +102,8 @@ class PredictionServer:
                  telemetry_out: str = "", request_timeout: float = 60.0,
                  max_inflight: int = 64, trace: bool = False,
                  trace_out: str = "", trace_capacity: int = 65536,
-                 stats_out: str = "", stats_interval_s: float = 10.0):
+                 stats_out: str = "", stats_interval_s: float = 10.0,
+                 record_rows: int = 0):
         self.host = host
         self.port = int(port)
         self.max_batch_rows = int(max_batch_rows)
@@ -108,6 +125,12 @@ class PredictionServer:
         self.stats_out = stats_out
         self.stats_interval_s = float(stats_interval_s)
         self._stats_thread: Optional[threading.Thread] = None
+        # bounded traffic ring for the lifecycle shadow loop; capacity 0
+        # (the default) keeps the request path a single attribute check
+        self.recorder = TrafficRecorder(record_rows)
+        # set by LifecycleController when one is bound to this server;
+        # report() then carries the "lifecycle" section
+        self.lifecycle = None
         self.buckets = bucket_ladder(min_bucket, max_batch_rows)
         self.registry = registry or ModelRegistry(
             stats=self.stats, warm_buckets=self.buckets, warmup=warmup)
@@ -176,8 +199,11 @@ class PredictionServer:
     # -- report --------------------------------------------------------------
 
     def report(self) -> Dict[str, Any]:
-        return self.stats.report(models=self.registry.versions(),
-                                 jit_entries=self.registry.jit_entries())
+        rep = self.stats.report(models=self.registry.versions(),
+                                jit_entries=self.registry.jit_entries())
+        if self.lifecycle is not None:
+            rep["lifecycle"] = self.lifecycle.section()
+        return rep
 
     def trace(self) -> Optional[Dict[str, Any]]:
         """The captured Chrome trace-event JSON object (``None`` when
@@ -288,6 +314,10 @@ class PredictionServer:
             return {"ok": True,
                     "ready": bool(models) and not self._stop.is_set(),
                     "models": models,
+                    # serving + retained-previous version per model, so an
+                    # operator sees what is live and what a rollback
+                    # would restore
+                    "versions": self.registry.versions_detail(),
                     **self.admission.snapshot()}
         if op == "predict":
             # the request's causal id: client-supplied, or minted here
@@ -312,6 +342,9 @@ class PredictionServer:
                 name = msg.get("model", "default")
                 model = self.registry.get(name)
                 X = np.atleast_2d(np.asarray(msg["data"], dtype=np.float64))
+                # lifecycle traffic capture: the shadow loop replays
+                # candidates against what the server actually answered
+                self.recorder.record(X)
                 span = self.tracer.span(
                     "serve.request", cat="serving", trace_id=trace_id,
                     args={"model": name, "rows": int(X.shape[0])}) \
@@ -325,6 +358,12 @@ class PredictionServer:
                 if trace_id is not None:
                     resp["trace_id"] = trace_id
                 return resp
+            except Exception:
+                # an admitted request answering with an error frame — the
+                # rate the lifecycle rollback watchdog judges a fresh
+                # promotion by
+                self.stats.record_error()
+                raise
             finally:
                 self.admission.release()
                 # admission→response latency, errors included — the p99
@@ -357,20 +396,92 @@ class PredictionServer:
 
 
 class ServingClient:
-    """Tiny blocking client for ``PredictionServer`` (same framing)."""
+    """Tiny blocking client for ``PredictionServer`` (same framing).
 
-    def __init__(self, host: str, port: int, timeout: float = 60.0):
-        self._sock = socket.create_connection((host, port), timeout=timeout)
-        self._sock.settimeout(timeout)
+    Transport failures — refused/dropped connections, recv timeouts,
+    torn frames — retry with bounded exponential backoff (the SocketNet
+    reconnect pattern, `io/net.py`), reconnecting between attempts;
+    after ``retries`` failed attempts a typed ``ServerUnavailable``
+    raises.  Structured SERVER decisions are never retried blindly: a
+    shed/overload frame raises ``ServerOverloaded`` immediately (the
+    server is alive and explicitly refusing — hammering it back is how
+    retry storms start) and error frames raise ``RuntimeError``.
+    """
+
+    def __init__(self, host: str, port: int, timeout: float = 60.0,
+                 retries: int = 3, backoff_s: float = 0.05):
+        self._host = host
+        self._port = int(port)
+        self._timeout = float(timeout)
+        self._retries = max(int(retries), 0)
+        self._backoff_s = float(backoff_s)
+        self._sock: Optional[socket.socket] = None
         self._lock = threading.Lock()
+        with self._lock:
+            self._connect_locked()
+
+    def _connect_locked(self) -> None:
+        """(Re)connect under ``self._lock`` with the bounded
+        backoff-retry loop; transient connect errors count into the
+        reliability table."""
+        from ..reliability.metrics import rel_inc
+        self._close_locked()
+        backoff = self._backoff_s
+        last: Optional[BaseException] = None
+        for attempt in range(self._retries + 1):
+            try:
+                s = socket.create_connection((self._host, self._port),
+                                             timeout=self._timeout)
+                s.settimeout(self._timeout)
+                self._sock = s
+                return
+            except OSError as e:
+                last = e
+                rel_inc("serve.client_connect_retries")
+                if attempt >= self._retries:
+                    break
+                time.sleep(backoff)
+                backoff = min(backoff * 2, 1.0)
+        raise ServerUnavailable(self._retries + 1, last)
+
+    def _close_locked(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
 
     def _call(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+        from ..reliability.metrics import rel_inc
         with self._lock:
-            send_frame(self._sock, msg)
-            resp = recv_frame(self._sock)
+            backoff = self._backoff_s
+            last: Optional[BaseException] = None
+            resp = None
+            for attempt in range(self._retries + 1):
+                try:
+                    if self._sock is None:
+                        self._connect_locked()
+                    send_frame(self._sock, msg)
+                    resp = recv_frame(self._sock)
+                    break
+                except ServerUnavailable:
+                    raise
+                except (ConnectionError, socket.timeout, OSError,
+                        EOFError) as e:
+                    # transient transport failure: drop the socket and
+                    # retry the whole send/recv on a fresh connection
+                    last = e
+                    self._close_locked()
+                    rel_inc("serve.client_call_retries")
+                    if attempt >= self._retries:
+                        raise ServerUnavailable(attempt + 1, last) from e
+                    time.sleep(backoff)
+                    backoff = min(backoff * 2, 1.0)
         if not resp.get("ok"):
             if resp.get("shed"):
-                # structured overload: typed, with the echoed trace_id
+                # structured overload: typed, with the echoed trace_id —
+                # an explicit server decision, NOT retried
                 raise ServerOverloaded(resp)
             raise RuntimeError(f"server error: {resp.get('error')}")
         return resp
@@ -412,10 +523,8 @@ class ServingClient:
         self._call({"op": "shutdown"})
 
     def close(self) -> None:
-        try:
-            self._sock.close()
-        except OSError:
-            pass
+        with self._lock:
+            self._close_locked()
 
     def __enter__(self) -> "ServingClient":
         return self

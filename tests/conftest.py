@@ -57,7 +57,8 @@ _SERVING_TIMEOUT_S = 120
 def pytest_runtest_call(item):
     marker = item.get_closest_marker("serving") \
         or item.get_closest_marker("chaos") \
-        or item.get_closest_marker("analysis")
+        or item.get_closest_marker("analysis") \
+        or item.get_closest_marker("lifecycle")
     if marker is None or not hasattr(signal, "SIGALRM"):
         yield
         return
