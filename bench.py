@@ -26,6 +26,12 @@ Usage: ``python bench.py``          — both scales, one JSON line.
        stall counters, collective accounting — observability/schema.json)
        next to the headline metric, so BENCH_r*.json rounds carry phase
        breakdowns.
+       ``--tree-learner MODE``       — parallel-mode passthrough
+       (serial/data/feature/voting/data_feature) so the driver captures
+       per-mode JSON lines without editing this script; recorded in the
+       ``metric`` string.
+       ``--parallel-mesh SHAPE``     — mesh-shape passthrough ("8", "2x4";
+       data×feature for data_feature).
 """
 
 import gc
@@ -38,7 +44,7 @@ import numpy as np
 
 
 def run_scale(rows: int, iters: int, warmup: int = 2,
-              telemetry: bool = False):
+              telemetry: bool = False, extra_params: dict = None):
     """Train steady-state iterations at one scale; returns
     (iters/sec, telemetry report or None)."""
     import lightgbm_tpu as lgb
@@ -53,6 +59,8 @@ def run_scale(rows: int, iters: int, warmup: int = 2,
     params = {"objective": "binary", "num_leaves": 255, "max_bin": 255,
               "learning_rate": 0.1, "min_data_in_leaf": 20,
               "verbosity": -1, "metric": "none", "telemetry": telemetry}
+    if extra_params:
+        params.update(extra_params)
     ds = lgb.Dataset(X, label=y, params=params)
     bst = lgb.Booster(params, ds)
 
@@ -79,14 +87,14 @@ def ref_ips(rows: int) -> float:
     return (500.0 / 238.5) * (10.5e6 / rows)  # reference CPU, row-scaled
 
 
-def _pop_telemetry_arg(argv):
-    """Extract ``--telemetry-out PATH`` / ``--telemetry-out=PATH``."""
+def _pop_opt_arg(argv, flag):
+    """Extract ``--flag VALUE`` / ``--flag=VALUE`` from an argv list."""
     out = None
     rest = []
     i = 0
     while i < len(argv):
         a = argv[i]
-        if a.startswith("--telemetry-out"):
+        if a.startswith(flag):
             if "=" in a:
                 out = a.split("=", 1)[1]
             elif i + 1 < len(argv):
@@ -99,18 +107,29 @@ def _pop_telemetry_arg(argv):
 
 
 def main():
-    telemetry_out, argv = _pop_telemetry_arg(sys.argv[1:])
+    telemetry_out, argv = _pop_opt_arg(sys.argv[1:], "--telemetry-out")
+    tree_learner, argv = _pop_opt_arg(argv, "--tree-learner")
+    parallel_mesh, argv = _pop_opt_arg(argv, "--parallel-mesh")
     telem = telemetry_out is not None
+    extra = {}
+    mode_tag = ""
+    if tree_learner:
+        extra["tree_learner"] = tree_learner
+        mode_tag = f", tree_learner={tree_learner}"
+    if parallel_mesh:
+        extra["parallel_mesh"] = parallel_mesh
+        mode_tag += f", mesh={parallel_mesh}"
     reports = {}
     if argv:  # single-scale profiling mode
         rows = int(argv[0])
         iters = int(argv[1]) if len(argv) > 1 else 10
-        ips, rep = run_scale(rows, iters, telemetry=telem)
+        ips, rep = run_scale(rows, iters, telemetry=telem,
+                             extra_params=extra)
         if rep is not None:
             reports[str(rows)] = rep
         line = {
             "metric": f"boosting iters/sec (synthetic Higgs-like {rows}x28, "
-                      "255 leaves, 255 bins)",
+                      f"255 leaves, 255 bins{mode_tag})",
             "value": round(ips, 4),
             "unit": "iters/sec",
             "vs_baseline": round(ips / ref_ips(rows), 4),

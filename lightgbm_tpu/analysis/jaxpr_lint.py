@@ -54,6 +54,7 @@ PROGRAM_FILES = {
     "wave_sharded_data": "lightgbm_tpu/parallel/wave_sharded.py",
     "wave_sharded_voting": "lightgbm_tpu/parallel/wave_sharded.py",
     "wave_feature": "lightgbm_tpu/parallel/feature_sharded.py",
+    "wave_sharded_2d": "lightgbm_tpu/parallel/wave2d_sharded.py",
     "serving_bin": "lightgbm_tpu/serving/binner.py",
     "serving_traverse": "lightgbm_tpu/predictor.py",
 }
@@ -239,6 +240,43 @@ def _trace_wave_sharded(kind: str):
     return jax.make_jaxpr(fn)(learner.sharded_bins(), z, z, z, fmask_pad)
 
 
+def _trace_wave_sharded_2d():
+    """The 2-D hybrid wave tree step on a (data=2, feature=2) mesh.  The
+    toy dataset's 8 padded features pack to 2 words, so feature-axis=2 is
+    the word-aligned tile limit at this width (tests use wider problems
+    for 2x4 shapes)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from ..config import Config
+    from ..parallel.compact_sharded import shard_map
+    from ..parallel.sharding import AXIS_DATA, AXIS_FEATURE, make_mesh
+    from ..parallel.wave2d_sharded import ShardedWave2DLearner, \
+        wave2d_ineligible_reason
+
+    params = dict(_BASE_PARAMS, enable_bundle=False)
+    ds = _toy_dataset(2048, 8, params)
+    mesh = make_mesh(shape=(2, 2), axis_names=(AXIS_DATA, AXIS_FEATURE))
+    cfg = Config.from_params(dict(params, tree_learner="data_feature"))
+    reason = wave2d_ineligible_reason(cfg, ds.constructed, mesh)
+    assert reason is None, f"gate dataset ineligible for 2D: {reason}"
+    learner = ShardedWave2DLearner(cfg, ds.constructed, mesh)
+    ax, fx = learner.axis, learner.faxis
+    kw = dict(mesh=mesh,
+              in_specs=(P(fx, ax), P(ax), P(ax), P(ax), P()),
+              out_specs=(P(), P(), P(), P(ax), P()))
+    try:
+        fn = shard_map(learner._train_tree_wave_sharded, check_vma=False,
+                       **kw)
+    except TypeError:
+        fn = shard_map(learner._train_tree_wave_sharded, check_rep=False,
+                       **kw)
+    z = jnp.zeros(learner.n_pad, jnp.float32)
+    fmask_pad = jnp.ones(learner.f_pad, bool)
+    return jax.make_jaxpr(fn)(learner.sharded_bins(), z, z, z, fmask_pad)
+
+
 def _trace_serving_bin():
     import jax
     import numpy as np
@@ -298,6 +336,8 @@ def program_builders(need_mesh_of: int = 2
         builders["wave_sharded_voting"] = \
             lambda: _trace_wave_sharded("voting")
         builders["wave_feature"] = lambda: _trace_wave_sharded("feature")
+    if len(jax.devices()) >= 2 * need_mesh_of:
+        builders["wave_sharded_2d"] = _trace_wave_sharded_2d
     return builders
 
 

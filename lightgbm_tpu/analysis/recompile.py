@@ -111,6 +111,7 @@ def _learner_jits(learner) -> Dict[str, Any]:
 def run() -> Tuple[List[Finding], Dict[str, Any], Optional[str]]:
     """Gate pass: ``(findings, detail, skip_reason)``.  ``detail`` records
     the per-program (before, after) cache fingerprints."""
+    import jax
     import numpy as np
 
     from ..predictor import _predict_all
@@ -125,6 +126,35 @@ def run() -> Tuple[List[Finding], Dict[str, Any], Optional[str]]:
     jits = _learner_jits(learner)
     for name, fn in jits.items():
         sentinel.register(name, fn, "lightgbm_tpu/learner_wave.py")
+
+    # -- 2D hybrid training step (tree_learner=data_feature on a 2x2
+    # mesh): the warmed wave program must not retrace across steady-state
+    # iterations — a mesh-shape or placement change that silently
+    # invalidates the shard_map cache shows up here
+    bst2 = None
+    if len(jax.devices()) >= 4:
+        import lightgbm_tpu as lgb
+
+        from ..parallel.wave2d_sharded import ShardedWave2DLearner
+
+        rng = np.random.default_rng(1)
+        X2 = rng.standard_normal((2048, 8))
+        y2 = (X2[:, 0] + 0.5 * X2[:, 1] > 0).astype(float)
+        params2 = {"objective": "binary", "num_leaves": 7,
+                   "min_data_in_leaf": 5, "verbosity": -1,
+                   "tree_learner": "data_feature", "parallel_mesh": "2x2",
+                   "enable_bundle": False}
+        ds2 = lgb.Dataset(X2, label=y2, params=params2)
+        bst2 = lgb.Booster(params2, ds2)
+        for _ in range(2):
+            bst2.update()
+        if isinstance(bst2.gbdt.learner, ShardedWave2DLearner):
+            for name, fn in _learner_jits(bst2.gbdt.learner).items():
+                sentinel.register(
+                    f"2d_{name}", fn,
+                    "lightgbm_tpu/parallel/wave2d_sharded.py")
+        else:
+            bst2 = None                      # routed elsewhere: skip leg
 
     # -- serving: warm two buckets, fingerprint, replay in-bucket sizes
     model = ServingModel(bst)
@@ -141,6 +171,9 @@ def run() -> Tuple[List[Finding], Dict[str, Any], Optional[str]]:
     snap = sentinel.arm()
     for _ in range(2):
         bst.update()                         # same shapes: must not retrace
+    if bst2 is not None:
+        for _ in range(2):
+            bst2.update()                    # warmed 2D wave step likewise
     for bucket in buckets:
         for m in (1, bucket // 2, bucket):   # distinct in-bucket row counts
             Xpad = np.zeros((bucket, model.num_features))

@@ -468,11 +468,13 @@ class GBDT:
         # (`tree_learner=data|feature|voting`, SURVEY §2.7)
         self._mesh = None
         self._parallel_mode = None
-        if self.cfg.tree_learner in ("data", "feature", "voting") \
+        if self.cfg.tree_learner in ("data", "feature", "voting",
+                                     "data_feature") \
                 and len(jax.devices()) > 1:
             from ..parallel.learners import apply_parallel_sharding
-            from ..parallel.mesh import make_mesh
-            apply_parallel_sharding(self, make_mesh(), self.cfg.tree_learner)
+            from ..parallel.sharding import mesh_for_config
+            apply_parallel_sharding(self, mesh_for_config(self.cfg),
+                                    self.cfg.tree_learner)
 
     def add_valid_data(self, valid_data: Dataset, name: str,
                        metrics: Sequence[Metric]) -> None:
@@ -488,10 +490,12 @@ class GBDT:
 
     def _place_rows(self, arr: np.ndarray) -> jax.Array:
         """Upload a row-aligned vector, sharded like the training rows."""
-        if self._mesh is not None and self._parallel_mode in ("data", "voting"):
+        if self._mesh is not None and self._parallel_mode in \
+                ("data", "voting", "data_feature"):
             from jax.sharding import NamedSharding, PartitionSpec as P
+            from ..parallel.sharding import row_axis
             return jax.device_put(arr, NamedSharding(
-                self._mesh, P(self._mesh.axis_names[0])))
+                self._mesh, P(row_axis(self._mesh))))
         return jnp.asarray(arr)
 
     def _bagging(self, iter_: int) -> None:

@@ -312,6 +312,11 @@ class Config:
 
     # --- network ---
     num_machines: int = 1
+    # device-mesh shape for the parallel tree learners: "" / "auto" = all
+    # local devices (2-D auto-factored for tree_learner=data_feature);
+    # "8" = a flat 8-device mesh; "2x4" = a (data=2, feature=4) grid
+    # (`parallel/sharding.py:parse_mesh_shape`)
+    parallel_mesh: str = ""
     local_listen_port: int = 12400
     time_out: int = 120
     machine_list_filename: str = ""
@@ -343,6 +348,11 @@ class Config:
     # implementation / fallback); "auto" = compact
     tpu_learner: str = "auto"
     tpu_min_window: int = 2048  # smallest compacted histogram window
+    # wave-histogram double buffering (tree_learner=data_feature): the W
+    # member histograms accumulate in this many independent groups, each
+    # with its own reduce-scatter, so the collective of one group overlaps
+    # the next group's compute; 1 = single exchange per wave (round-6 flow)
+    tpu_wave_hist_buffers: int = 2
     # packed-histogram MXU precision: "bf16x3" (default; ~24 weight
     # mantissa bits — accuracy/ACCURACY.md measured it AUC-identical to
     # full-f32 on the real chip and the merged-dot kernel makes the third
@@ -567,10 +577,14 @@ class Config:
         tl = self.tree_learner
         tl = {"serial": "serial", "feature": "feature", "feature_parallel": "feature",
               "data": "data", "data_parallel": "data",
-              "voting": "voting", "voting_parallel": "voting"}.get(tl, tl)
+              "voting": "voting", "voting_parallel": "voting",
+              "data_feature": "data_feature", "hybrid": "data_feature",
+              "data_feature_parallel": "data_feature"}.get(tl, tl)
         self.tree_learner = tl
-        self.is_parallel = tl in ("feature", "data", "voting") and self.num_machines > 1
-        self.is_parallel_find_bin = tl == "data" and self.num_machines > 1
+        self.is_parallel = tl in ("feature", "data", "voting",
+                                  "data_feature") and self.num_machines > 1
+        self.is_parallel_find_bin = tl in ("data", "data_feature") \
+            and self.num_machines > 1
         if self.is_unbalance and abs(self.scale_pos_weight - 1.0) > 1e-6:
             raise ValueError(
                 "Cannot set is_unbalance and scale_pos_weight at the same time")

@@ -565,9 +565,7 @@ class WaveTPUTreeLearner(CompactTPUTreeLearner):
             lslot_r, rslot_r = ri(10), ri(11)
             sortable_r = pm[:, 12] > 0.5
             # per-row decision (NumericalDecisionInner `tree.h:233-249`)
-            word = jnp.zeros(ch_n, jnp.int32)
-            for wdi in range(fw):
-                word = word + jnp.where(widx_r == wdi, bins_c[wdi], 0)
+            word = self._word_select(bins_c, widx_r)
             code = (word >> shift_r) & 0xFF
             if self._bundle is not None:
                 r = code - boff_r
@@ -1014,6 +1012,27 @@ class WaveTPUTreeLearner(CompactTPUTreeLearner):
             lambda s: self._wave_body(s, feature_mask, width=ws),
             lambda s: self._wave_body(s, feature_mask), st)
 
+    # -- split-word extraction seams -----------------------------------------
+    # the decide pass and the stall partition both need the split feature's
+    # packed bin word per row.  Serial and 1-D learners hold every word
+    # locally; the 2-D data×feature learner holds only a word SLICE per
+    # device and overrides these with a masked-sum + feature-axis psum.
+
+    def _word_select(self, bins_c, widx_r):
+        """Per-row split-feature bin words from a (fw, rows) bins chunk.
+        ``widx_r`` carries packed-word indices in THIS learner's word
+        numbering (global == local here)."""
+        word = jnp.zeros(widx_r.shape[0], jnp.int32)
+        for wdi in range(self.fw):
+            word = word + jnp.where(widx_r == wdi, bins_c[wdi], 0)
+        return word
+
+    def _window_word(self, bw, col):
+        """One feature's packed bin word over a sliced (fw, S) window;
+        ``col`` is the packed column of the split feature."""
+        S = bw.shape[1]
+        return lax.dynamic_slice(bw, (col // 4, jnp.int32(0)), (1, S))[0]
+
     # -- the stall split (exact-replay correction) ---------------------------
 
     def _span_decide(self, bw, ww, lid, off, c, leaf, feat, thr, dleft,
@@ -1027,7 +1046,7 @@ class WaveTPUTreeLearner(CompactTPUTreeLearner):
         pos = jnp.arange(S, dtype=jnp.int32)
         in_seg = (pos >= off) & (pos < off + c) & (lid == leaf)
         col = self.fw_col[feat]
-        word = lax.dynamic_slice(bw, (col // 4, jnp.int32(0)), (1, S))[0]
+        word = self._window_word(bw, col)
         code = (word >> ((col % 4) * 8)) & 0xFF
         if self._bundle is not None:
             boffk = self.fw_goff[feat]

@@ -1,3 +1,6 @@
-from .mesh import make_mesh, shard_dataset
+from .sharding import (AXIS_DATA, AXIS_FEATURE, PlacementRules, make_mesh,
+                       mesh_for_config, parse_mesh_shape, row_axis,
+                       rules_for_mode)
+from .mesh import shard_dataset
 from .learners import (make_data_parallel, make_feature_parallel,
-                       apply_parallel_sharding)
+                       make_hybrid_parallel, apply_parallel_sharding)

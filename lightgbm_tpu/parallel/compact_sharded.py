@@ -57,11 +57,13 @@ class ShardedCompactLearner(CompactTPUTreeLearner):
     features."""
 
     _supports_bundle = False
+    _placement_mode = "data"     # rules_for_mode table this learner rides
 
     def __init__(self, cfg: Config, data: _ConstructedDataset, mesh: Mesh,
                  hist_backend: str = "auto"):
+        from .sharding import row_axis
         self.mesh = mesh
-        self.axis = mesh.axis_names[0]
+        self.axis = row_axis(mesh)
         self.D = int(np.prod(mesh.devices.shape))
         super().__init__(cfg, data, hist_backend)
         if self.n_pad % self.D:
@@ -75,19 +77,30 @@ class ShardedCompactLearner(CompactTPUTreeLearner):
         self.f_pad = f_pad
         self.fs = f_pad // self.D            # features per shard (padded)
         # local window buckets (windows live in the local row axis)
+        self._init_local_windows(cfg, self.n_local)
+        self._use_pallas = False  # local XLA one-hot path under shard_map
+        self._pad_feature_meta(data, f_pad)
+        self._sharded_bins = None
+        self._jit_tree_c = None  # built lazily (needs the sharded bins)
+
+    def _init_local_windows(self, cfg: Config, n_local: int) -> None:
+        """Window-bucket ladder over the local row axis (shared by every
+        sharded learner; feature-parallel passes the FULL row count)."""
         mw = max(int(cfg.tpu_min_window), 1024)
         mw = 1 << (mw - 1).bit_length()
         sizes = []
         s0 = mw
-        while s0 < self.n_local:
+        while s0 < n_local:
             sizes.append(s0)
             s0 *= 2
-        sizes.append(self.n_local)
+        sizes.append(n_local)
         self._win_sizes = sizes
         self._win_sizes_arr = jnp.asarray(sizes, dtype=jnp.int32)
-        self._use_pallas = False  # local XLA one-hot path under shard_map
-        # feature metadata padded to f_pad so shard slices are uniform;
-        # padding slots are trivial features (num_bin=0 → -inf gain)
+
+    def _pad_feature_meta(self, data: _ConstructedDataset,
+                          f_pad: int) -> None:
+        """Feature metadata padded to f_pad so shard slices are uniform;
+        padding slots are trivial features (num_bin=0 → -inf gain)."""
         num_bin, missing, default_bin, is_cat = data.feature_meta_arrays()
         pad = f_pad - len(num_bin)
         zp = lambda a, fill=0: np.concatenate(
@@ -112,8 +125,6 @@ class ShardedCompactLearner(CompactTPUTreeLearner):
         self.f_default_bin = self.fp_default_bin
         if self.has_monotone:
             self.f_monotone = self.fp_monotone
-        self._sharded_bins = None
-        self._jit_tree_c = None  # built lazily (needs the sharded bins)
 
     def _rows_len(self) -> int:
         return self.n_local
@@ -148,17 +159,20 @@ class ShardedCompactLearner(CompactTPUTreeLearner):
                         self.axis)
         return self._fix_hrow(hrow, fi, sum_g, sum_h, cnt)
 
-    # -- sharded data placement ---------------------------------------------
+    # -- sharded data placement (rule-driven, `parallel/sharding.py`) --------
+
+    def _rules(self):
+        from .sharding import rules_for_mode
+        return rules_for_mode(self._placement_mode, self.mesh)
 
     def sharded_bins(self) -> jax.Array:
         if self._sharded_bins is None:
-            packed = self.bins_packed()
-            self._sharded_bins = jax.device_put(
-                packed, NamedSharding(self.mesh, P(None, self.axis)))
+            self._sharded_bins = self._rules().place("bins",
+                                                     self.bins_packed())
         return self._sharded_bins
 
     def _row_sharded(self, arr):
-        return jax.device_put(arr, NamedSharding(self.mesh, P(self.axis)))
+        return self._rules().place("rows", arr)
 
     def _reduce_hist(self, local_hist):
         """Histogram exchange: reduce-scatter over the feature axis so each
@@ -477,6 +491,8 @@ class ShardedVotingLearner(ShardedCompactLearner):
     scanned.  The histogram pool stays local-unreduced so parent
     subtraction needs no extra wire traffic — communicated volume per split
     drops from (F, B, 3) to (2k, B, 3)."""
+
+    _placement_mode = "voting"
 
     def __init__(self, cfg: Config, data: _ConstructedDataset, mesh: Mesh,
                  hist_backend: str = "auto"):

@@ -36,21 +36,14 @@ class FeatureShardedCompactLearner(ShardedCompactLearner):
     """`tree_learner=feature` on the compact learner: replicated rows,
     feature-sliced histograms + scans, allgathered best splits."""
 
+    _placement_mode = "feature"
+
     def __init__(self, cfg: Config, data: _ConstructedDataset, mesh: Mesh,
                  hist_backend: str = "auto"):
         super().__init__(cfg, data, mesh, hist_backend)
         # rows are replicated: window buckets span the FULL row axis
         self.n_local = self.n_pad
-        mw = max(int(cfg.tpu_min_window), 1024)
-        mw = 1 << (mw - 1).bit_length()
-        sizes = []
-        s0 = mw
-        while s0 < self.n_pad:
-            sizes.append(s0)
-            s0 *= 2
-        sizes.append(self.n_pad)
-        self._win_sizes = sizes
-        self._win_sizes_arr = jnp.asarray(sizes, dtype=jnp.int32)
+        self._init_local_windows(cfg, self.n_pad)
         # pad the packed-word axis to a mesh multiple (padding words carry
         # num_bin=0 features -> -inf gains, never selected)
         self.fw2 = ((self.fw + self.D - 1) // self.D) * self.D
@@ -146,14 +139,12 @@ class FeatureShardedCompactLearner(ShardedCompactLearner):
         # replicated bins: every worker holds all rows and features, the
         # reference feature-parallel data model
         if self._sharded_bins is None:
-            from jax.sharding import NamedSharding
             packed = self.bins_packed()
             if packed.shape[0] != self.fw2:
                 packed = jnp.concatenate(
                     [packed, jnp.zeros((self.fw2 - packed.shape[0],
                                         packed.shape[1]), packed.dtype)])
-            self._sharded_bins = jax.device_put(
-                packed, NamedSharding(self.mesh, P(None, None)))
+            self._sharded_bins = self._rules().place("bins", packed)
         return self._sharded_bins
 
 

@@ -32,7 +32,8 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from .mesh import make_mesh
+from .sharding import (AXIS_DATA, AXIS_FEATURE, default_mesh_shape_2d,
+                       feature_axis, make_mesh, row_axis, rules_for_mode)
 
 
 def _route_log(cfg, msg: str) -> None:
@@ -61,7 +62,6 @@ def apply_parallel_sharding(gbdt, mesh: Mesh, mode: str) -> None:
     steps compile under GSPMD with collectives over the mesh."""
     from ..learner import TPUTreeLearner
 
-    axis = mesh.axis_names[0]
     # pipelined iterations queued before the swap hold compact-format records
     # — materialize them with the learner that produced them
     if hasattr(gbdt, "_flush_pending"):
@@ -71,6 +71,37 @@ def apply_parallel_sharding(gbdt, mesh: Mesh, mode: str) -> None:
     # don't carry the forced phase, mirroring the serial factory's routing)
     forced = getattr(learner, "_forced", None)
     mesh_size = max(int(np.prod(mesh.devices.shape)), 1)
+    if mode == "data_feature":
+        from .wave2d_sharded import (ShardedWave2DLearner,
+                                     wave2d_ineligible_reason)
+        if len(mesh.axis_names) < 2:
+            # a flat mesh was passed: factor it into (data, feature)
+            mesh = make_mesh(shape=default_mesh_shape_2d(mesh_size),
+                             devices=list(mesh.devices.reshape(-1)),
+                             axis_names=(AXIS_DATA, AXIS_FEATURE))
+        reason = ("forced splits ride the sequential sharded learner"
+                  if forced else
+                  wave2d_ineligible_reason(learner.cfg, learner.data, mesh))
+        if reason is None:
+            shp = dict(zip(mesh.axis_names, mesh.devices.shape))
+            _route_log(learner.cfg,
+                       f"tree_learner=data_feature: using "
+                       f"ShardedWave2DLearner over a "
+                       f"{shp[AXIS_DATA]}x{shp[AXIS_FEATURE]} "
+                       f"(data x feature) mesh")
+            gbdt.learner = ShardedWave2DLearner(learner.cfg, learner.data,
+                                                mesh)
+            _place_row_arrays(gbdt, mesh, mode)
+            gbdt._mesh = mesh
+            gbdt._parallel_mode = mode
+            return
+        _route_log(learner.cfg,
+                   f"tree_learner=data_feature: 2D hybrid ineligible "
+                   f"({reason}); falling back to tree_learner=data over a "
+                   f"flat {mesh_size}-device mesh")
+        apply_parallel_sharding(
+            gbdt, make_mesh(devices=list(mesh.devices.reshape(-1))), "data")
+        return
     fast_reason = _fast_gate_reason(learner.data, mesh_size) \
         if mode in ("data", "voting") else None
     if mode in ("data", "voting") and fast_reason is None:
@@ -160,11 +191,12 @@ def apply_parallel_sharding(gbdt, mesh: Mesh, mode: str) -> None:
         if forced:
             learner.set_forced_splits(forced)
         gbdt.learner = learner
+    ax_r, ax_f = row_axis(mesh), feature_axis(mesh)
     if mode in ("data", "voting"):
-        bins_spec = P(None, axis)      # (F, N): shard rows
-        row_spec = P(axis)
+        bins_spec = P(None, ax_r)      # (F, N): shard rows
+        row_spec = P(ax_r)
     elif mode == "feature":
-        bins_spec = P(axis, None)      # shard features, replicate rows
+        bins_spec = P(ax_f, None)      # shard features, replicate rows
         row_spec = P()
     else:
         raise ValueError(f"unknown parallel mode: {mode}")
@@ -184,7 +216,7 @@ def apply_parallel_sharding(gbdt, mesh: Mesh, mode: str) -> None:
     # row-aligned vectors
     gbdt._valid_rows = put(gbdt._valid_rows, row_spec)
     gbdt._bag_mask = put(gbdt._bag_mask, row_spec)
-    score_spec = P(None, axis) if mode in ("data", "voting") else P()
+    score_spec = P(None, ax_r) if mode in ("data", "voting") else P()
     gbdt.train_score.score = put(gbdt.train_score.score, score_spec)
     # objective label arrays follow the rows
     obj = gbdt.objective
@@ -193,7 +225,7 @@ def apply_parallel_sharding(gbdt, mesh: Mesh, mode: str) -> None:
                      "label_w", "label_weight", "label_onehot"):
             arr = getattr(obj, name, None)
             if arr is not None and hasattr(arr, "shape") and arr.ndim >= 1:
-                spec = row_spec if arr.ndim == 1 else P(None, axis) \
+                spec = row_spec if arr.ndim == 1 else P(None, ax_r) \
                     if mode in ("data", "voting") else P()
                 try:
                     setattr(obj, name, put(arr, spec))
@@ -207,22 +239,20 @@ def apply_parallel_sharding(gbdt, mesh: Mesh, mode: str) -> None:
 
 def _place_row_arrays(gbdt, mesh: Mesh, mode: str) -> None:
     """Shard the boosting loop's row-aligned arrays (score, bagging mask,
-    objective label arrays) over the mesh's row axis."""
-    axis = mesh.axis_names[0]
-    row_spec = P(axis)
-    put = lambda arr, spec: jax.device_put(arr, NamedSharding(mesh, spec))
-    gbdt._valid_rows = put(gbdt._valid_rows, row_spec)
-    gbdt._bag_mask = put(gbdt._bag_mask, row_spec)
-    gbdt.train_score.score = put(gbdt.train_score.score, P(None, axis))
+    objective label arrays) over the mesh — rule-driven
+    (`parallel/sharding.py`), so the same call covers 1-D and 2-D modes."""
+    rules = rules_for_mode(mode, mesh)
+    gbdt._valid_rows = rules.place("valid_rows", gbdt._valid_rows)
+    gbdt._bag_mask = rules.place("bag_mask", gbdt._bag_mask)
+    gbdt.train_score.score = rules.place("score", gbdt.train_score.score)
     obj = gbdt.objective
     if obj is not None:
         for name in ("label", "weights", "trans_label", "label_sign",
                      "label_w", "label_weight", "label_onehot"):
             arr = getattr(obj, name, None)
             if arr is not None and hasattr(arr, "shape") and arr.ndim >= 1:
-                spec = row_spec if arr.ndim == 1 else P(None, axis)
                 try:
-                    setattr(obj, name, put(arr, spec))
+                    setattr(obj, name, rules.place(name, arr))
                 except Exception as e:
                     import warnings
                     warnings.warn(f"could not shard objective array "
@@ -240,4 +270,15 @@ def make_feature_parallel(gbdt, num_devices: Optional[int] = None) -> Mesh:
     """`tree_learner=feature` over the local mesh."""
     mesh = make_mesh(num_devices)
     apply_parallel_sharding(gbdt, mesh, "feature")
+    return mesh
+
+
+def make_hybrid_parallel(gbdt, shape=None) -> Mesh:
+    """`tree_learner=data_feature` over a 2-D (data, feature) mesh;
+    ``shape=(2, 4)``-style, auto-factored over the local devices when
+    omitted."""
+    if shape is None:
+        shape = default_mesh_shape_2d(len(jax.devices()))
+    mesh = make_mesh(shape=shape, axis_names=(AXIS_DATA, AXIS_FEATURE))
+    apply_parallel_sharding(gbdt, mesh, "data_feature")
     return mesh

@@ -1,4 +1,4 @@
-"""Device mesh construction and dataset sharding.
+"""Device mesh construction and dataset sharding (compatibility shims).
 
 TPU-native replacement for the reference's process-level distribution setup
 (`Network::Init`, `src/network/linkers_socket.cpp:20-218`: machine-list
@@ -8,53 +8,60 @@ is inserted by XLA over ICI/DCN — there is no hand-written Bruck allgather or
 recursive-halving reduce-scatter to port (`src/network/network.cpp:64-330`),
 because the compiler owns the schedule.
 
-Axes:
-  * ``data``    — row shards (data-parallel learner, `tree_learner=data`)
-  * ``feature`` — feature shards (feature-parallel, `tree_learner=feature`)
+Round 7: the mode-specific helpers here (``shard_dataset``,
+``row_sharding``) are DEPRECATED in favor of the rule-driven layer in
+`parallel/sharding.py` (:func:`rules_for_mode` /
+:class:`~.sharding.PlacementRules`), which also fixes the old helpers'
+hardcoded ``mesh.axis_names[0]`` row-axis assumption on N-D meshes.  They
+remain as thin aliases so round-3-era callers and tests don't churn.
+``make_mesh`` IS the supported entry point — it now lives in
+`parallel/sharding.py` and grows N-D ``("data", "feature")`` support; the
+re-export keeps the old import path working.
 """
 
 from __future__ import annotations
 
-from typing import Optional, Sequence
+import warnings
 
 import jax
-import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .sharding import (  # noqa: F401  (re-exported API)
+    AXIS_DATA, AXIS_FEATURE, feature_axis, make_mesh, mesh_for_config,
+    parse_mesh_shape, row_axis, rules_for_mode)
 
-def make_mesh(num_devices: Optional[int] = None, axis_name: str = "data",
-              devices: Optional[Sequence] = None) -> Mesh:
-    """1-D mesh over the available devices (the analogue of the reference's
-    ``num_machines``/``machine_list`` config, `config.h:690-717`)."""
-    if devices is None:
-        devices = jax.devices()
-        if num_devices is not None:
-            devices = devices[:num_devices]
-    return Mesh(np.asarray(devices), (axis_name,))
+
+def _deprecated(old: str, new: str) -> None:
+    warnings.warn(f"lightgbm_tpu.parallel.mesh.{old} is deprecated; use "
+                  f"{new} (parallel/sharding.py)", DeprecationWarning,
+                  stacklevel=3)
 
 
 def shard_dataset(data, mesh: Mesh, mode: str = "data"):
-    """Place a constructed dataset's device arrays for a parallel mode.
+    """DEPRECATED: use ``rules_for_mode(mode, mesh).place("bins", ...)``.
 
-    data-parallel: rows sharded (`data_parallel_tree_learner.cpp:49` —
-    each machine owns a row shard); feature-parallel: features sharded
-    (`feature_parallel_tree_learner.cpp:29` — each machine owns features).
-    Returns the sharded bins array; row-aligned vectors must use
-    ``row_sharding(mesh)``.
-    """
-    axis = mesh.axis_names[0]
-    if mode == "data":
-        spec = P(None, axis)    # bins (F, N): shard rows
-    elif mode == "feature":
-        spec = P(axis, None)    # shard features
-    else:
+    Places a constructed dataset's bins for a parallel mode and returns the
+    sharded array; now rule-driven, so it resolves the row/feature axes by
+    NAME and works on N-D meshes (the old version assumed
+    ``mesh.axis_names[0]`` was the row axis)."""
+    _deprecated("shard_dataset", "rules_for_mode(mode, mesh).place")
+    if mode == "feature":
+        # legacy behavior: the round-3 helper sharded the raw bins over
+        # features (the modern feature-sharded learners replicate bins and
+        # slice by axis_index — see rules_for_mode)
+        return jax.device_put(data.device_bins(),
+                              NamedSharding(mesh, P(feature_axis(mesh),
+                                                    None)))
+    if mode not in ("data", "voting", "data_feature"):
         raise ValueError(f"unknown parallel mode {mode}")
-    sharding = NamedSharding(mesh, spec)
-    return jax.device_put(data.device_bins(), sharding)
+    return rules_for_mode(mode, mesh).place("bins", data.device_bins())
 
 
 def row_sharding(mesh: Mesh) -> NamedSharding:
-    return NamedSharding(mesh, P(mesh.axis_names[0]))
+    """DEPRECATED: use ``rules_for_mode(...).sharding_for("rows")`` or
+    ``NamedSharding(mesh, P(row_axis(mesh)))``."""
+    _deprecated("row_sharding", "row_axis")
+    return NamedSharding(mesh, P(row_axis(mesh)))
 
 
 def replicated(mesh: Mesh) -> NamedSharding:

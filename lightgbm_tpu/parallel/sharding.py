@@ -1,0 +1,216 @@
+"""Declarative mesh/sharding layer: N-D meshes + regex placement rules.
+
+The reference wires three DISJOINT parallel modes over a hand-written
+Allreduce/ReduceScatter layer (`src/treelearner/*_parallel_tree_learner.cpp`
++ `src/network/network.cpp:64-330`); our earlier rounds mirrored that split
+with per-mode hand-placed ``device_put`` calls scattered through
+`parallel/learners.py` and the sharded learners.  This module replaces the
+hand placement with the GSPMD idiom (the mesh-helper / partition-rules
+pattern of SNIPPETS.md [2]/[3]):
+
+  * :func:`make_mesh` builds 1-D *or* N-D meshes over named axes
+    (``("data", "feature")``) — the analogue of the reference's
+    ``num_machines``/``machine_list`` config grown to two dimensions;
+  * :class:`PlacementRules` maps array NAMES to ``PartitionSpec``s via an
+    ordered regex table (first match wins), so "bins shard
+    features×rows, row vectors shard rows, metadata replicates" is ONE
+    declarative table per mode instead of a dozen call sites;
+  * :func:`rules_for_mode` holds those per-mode tables, including the 2-D
+    hybrid ``data_feature`` mode (bins ``P("feature", "data")``).
+
+Axes:
+  * ``data``    — row shards (`tree_learner=data|voting`, and the row axis
+    of ``data_feature``)
+  * ``feature`` — feature shards (`tree_learner=feature`, and the feature
+    axis of ``data_feature``)
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXIS_DATA = "data"
+AXIS_FEATURE = "feature"
+
+
+# -- mesh construction --------------------------------------------------------
+
+def make_mesh(num_devices: Optional[int] = None, axis_name: str = AXIS_DATA,
+              devices: Optional[Sequence] = None,
+              shape: Optional[Sequence[int]] = None,
+              axis_names: Optional[Sequence[str]] = None) -> Mesh:
+    """Mesh over the available devices.
+
+    1-D (the round-3 signature, unchanged): ``make_mesh(4)`` → 4 devices
+    on axis ``data``.  N-D: ``make_mesh(shape=(2, 4),
+    axis_names=("data", "feature"))`` → a 2×4 grid, the analogue of the
+    reference's ``num_machines`` config grown to a second dimension.
+    """
+    if devices is None:
+        devices = jax.devices()
+        if shape is not None:
+            need = int(np.prod(shape))
+            if len(devices) < need:
+                raise ValueError(
+                    f"mesh shape {tuple(shape)} needs {need} devices, "
+                    f"platform has {len(devices)}")
+            devices = devices[:need]
+        elif num_devices is not None:
+            devices = devices[:num_devices]
+    if shape is None:
+        return Mesh(np.asarray(devices), (axis_name,))
+    if axis_names is None:
+        axis_names = (AXIS_DATA, AXIS_FEATURE)[:len(shape)]
+    if len(axis_names) != len(shape):
+        raise ValueError(f"axis_names {tuple(axis_names)} does not match "
+                         f"mesh shape {tuple(shape)}")
+    return Mesh(np.asarray(devices).reshape(tuple(shape)),
+                tuple(axis_names))
+
+
+def parse_mesh_shape(spec: str) -> Optional[Tuple[int, ...]]:
+    """``"2x4"`` → ``(2, 4)``; ``"8"`` → ``(8,)``; ``""``/``"auto"`` →
+    None (let the mode pick).  The ``parallel_mesh`` config grammar —
+    for ``data_feature`` the order is data×feature."""
+    s = str(spec or "").strip().lower()
+    if s in ("", "auto"):
+        return None
+    parts = [p for p in re.split(r"[x*,]", s) if p]
+    try:
+        dims = tuple(int(p) for p in parts)
+    except ValueError:
+        raise ValueError(f"parallel_mesh={spec!r} is not of the form "
+                         f"'D' or 'DxF'")
+    if not dims or any(d <= 0 for d in dims) or len(dims) > 2:
+        raise ValueError(f"parallel_mesh={spec!r} must be 1 or 2 positive "
+                         f"dims")
+    return dims
+
+
+def default_mesh_shape_2d(n_devices: int) -> Tuple[int, int]:
+    """Auto (data, feature) factorization: the feature axis gets the
+    smaller balanced factor (rows usually dominate, and the per-device
+    split-scan slice shrinks by the FULL device count either way)."""
+    n = max(int(n_devices), 1)
+    df = 1
+    for f in range(int(np.sqrt(n)), 0, -1):
+        if n % f == 0:
+            df = f
+            break
+    return n // df, df
+
+
+def mesh_for_config(cfg, devices: Optional[Sequence] = None) -> Mesh:
+    """The mesh a Config asks for: ``parallel_mesh`` ("2x4" = data×feature)
+    when set, else all local devices — 2-D for ``tree_learner=
+    data_feature``, 1-D otherwise."""
+    mode = getattr(cfg, "tree_learner", "serial")
+    shape = parse_mesh_shape(getattr(cfg, "parallel_mesh", ""))
+    ndev = len(devices) if devices is not None else len(jax.devices())
+    if mode == "data_feature":
+        if shape is None:
+            shape = default_mesh_shape_2d(ndev)
+        elif len(shape) == 1:
+            shape = default_mesh_shape_2d(shape[0])
+        return make_mesh(shape=shape, devices=devices,
+                         axis_names=(AXIS_DATA, AXIS_FEATURE))
+    if shape is not None:
+        return make_mesh(num_devices=int(np.prod(shape)), devices=devices)
+    return make_mesh(devices=devices)
+
+
+# -- axis resolution (the N-D fix for the old axis_names[0] assumption) ------
+
+def row_axis(mesh: Mesh) -> str:
+    """The row-shard axis of a mesh: ``data`` when present, else the first
+    axis (1-D meshes built with a custom axis name)."""
+    return AXIS_DATA if AXIS_DATA in mesh.axis_names else mesh.axis_names[0]
+
+
+def feature_axis(mesh: Mesh) -> str:
+    return AXIS_FEATURE if AXIS_FEATURE in mesh.axis_names \
+        else mesh.axis_names[0]
+
+
+# -- regex -> PartitionSpec rules (SNIPPETS.md [3] match_partition_rules) ----
+
+class PlacementRules:
+    """Ordered (regex, PartitionSpec) table bound to a mesh; first match
+    wins, no match replicates.  Names are '/'-joined pytree paths."""
+
+    def __init__(self, mesh: Mesh,
+                 rules: Sequence[Tuple[str, P]]) -> None:
+        self.mesh = mesh
+        self.rules: List[Tuple[Any, P]] = [
+            (re.compile(pat), spec) for pat, spec in rules]
+
+    def spec_for(self, name: str) -> P:
+        for pat, spec in self.rules:
+            if pat.search(name):
+                return spec
+        return P()
+
+    def sharding_for(self, name: str) -> NamedSharding:
+        return NamedSharding(self.mesh, self.spec_for(name))
+
+    def place(self, name: str, arr):
+        """device_put one named array per its matched rule."""
+        return jax.device_put(arr, self.sharding_for(name))
+
+    def place_tree(self, tree):
+        """Place every leaf of a pytree; leaf names are '/'-joined key
+        paths (dict keys / attr names / sequence indices)."""
+        from jax.tree_util import tree_flatten_with_path, tree_unflatten
+
+        def _key(k) -> str:
+            for attr in ("key", "name", "idx"):
+                if hasattr(k, attr):
+                    return str(getattr(k, attr))
+            return str(k)
+
+        leaves, treedef = tree_flatten_with_path(tree)
+        placed = [self.place("/".join(_key(k) for k in path), leaf)
+                  for path, leaf in leaves]
+        return tree_unflatten(treedef, placed)
+
+
+#: row-aligned 1-D vector names used across the boosting loop / objectives
+_ROW_VECTORS = (r"(^|/)(valid_rows|bag_mask|grad|hess|bag|rows|label|"
+                r"weights|trans_label|label_sign|label_w|label_weight)$")
+#: (K, N) row-aligned matrices (score table, one-hot labels)
+_ROW_MATRICES = r"(^|/)(score|label_onehot)$"
+
+
+def rules_for_mode(mode: str, mesh: Mesh) -> PlacementRules:
+    """The per-mode placement tables (the declarative replacement for the
+    hand-written device_put ladders of rounds 3-6)."""
+    d, f = row_axis(mesh), feature_axis(mesh)
+    if mode in ("data", "voting"):
+        table = [
+            (r"(^|/)bins$", P(None, d)),       # (F, N): shard rows
+            (_ROW_MATRICES, P(None, d)),
+            (_ROW_VECTORS, P(d)),
+        ]
+    elif mode == "feature":
+        # the reference feature-parallel data model: every worker holds all
+        # rows AND features (the shard_map body slices its word range by
+        # axis_index) — everything replicates, including bins
+        table = [
+            (r"(^|/)bins$", P(None, None)),
+        ]
+    elif mode == "data_feature":
+        table = [
+            (r"(^|/)bins$", P(f, d)),          # (F, N) tile per device
+            (_ROW_MATRICES, P(None, d)),
+            (_ROW_VECTORS, P(d)),
+        ]
+    else:
+        raise ValueError(f"unknown parallel mode {mode!r}")
+    # histograms / split state / feature metadata replicate (the sharded
+    # learners' shard_map programs own their internal scatter)
+    return PlacementRules(mesh, table)

@@ -1,0 +1,269 @@
+"""2-D hybrid data×feature training (`tree_learner=data_feature`).
+
+The reference keeps data- and feature-parallel as disjoint modes; the 2-D
+wave learner runs both on one mesh (`parallel/wave2d_sharded.py`).  Its
+contract is the same as every other parallel mode's — record-exact against
+the serial learner — but now across MESH SHAPES: (1, 4), (2, 2), (4, 1)
+and (2, 4) must all reproduce the serial records, with and without
+bagging, and the collective program must stay within the budget the two
+1-D modes would spend combined (`analysis/budgets.json`).
+"""
+
+import json
+import os
+import re
+
+import numpy as np
+import pytest
+
+import jax
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.parallel.learners import apply_parallel_sharding
+from lightgbm_tpu.parallel.sharding import (AXIS_DATA, AXIS_FEATURE,
+                                            default_mesh_shape_2d, make_mesh,
+                                            parse_mesh_shape, rules_for_mode)
+from lightgbm_tpu.parallel.wave2d_sharded import (ShardedWave2DLearner,
+                                                 wave2d_ineligible_reason)
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs an 8-virtual-device mesh")
+
+MESH_SHAPES = [(1, 4), (2, 2), (4, 1), (2, 4)]
+
+
+def _mesh2d(shape):
+    return make_mesh(shape=shape, axis_names=(AXIS_DATA, AXIS_FEATURE))
+
+
+def _problem(rng, n=4096, f=16):
+    X = rng.randn(n, f)
+    y = (X[:, 0] + 0.5 * X[:, 1] + 0.2 * rng.randn(n) > 0).astype(float)
+    return X, y
+
+
+def _train(X, y, mode, mesh_shape=None, rounds=3, **extra):
+    params = {"objective": "binary", "num_leaves": 15, "min_data_in_leaf": 5,
+              "verbosity": -1, "tree_learner": mode, "enable_bundle": False}
+    params.update(extra)
+    ds = lgb.Dataset(X, label=y, params=params)
+    bst = lgb.Booster(params, ds)
+    if mode != "serial":
+        mesh = _mesh2d(mesh_shape) if mesh_shape else make_mesh()
+        apply_parallel_sharding(bst.gbdt, mesh, mode)
+    for _ in range(rounds):
+        bst.update()
+    return bst
+
+
+def _structure(bst):
+    """Model structure lines — float formatting varies between assembly
+    paths, so compare the integral record fields plus predictions."""
+    keep = ("split_feature=", "num_leaves=", "decision_type=",
+            "left_child=", "right_child=")
+    return [ln for ln in bst.model_to_string().splitlines()
+            if ln.startswith(keep)]
+
+
+# -- record-level exactness across mesh shapes ------------------------------
+
+def test_wave2d_records_match_serial_all_shapes(rng):
+    """Same grad/hess → identical record stream as the SERIAL wave learner
+    for every mesh factorization (the acceptance bar: record-exact on the
+    2x4 mesh, plus the degenerate 1xD / Dx1 shapes which must coincide
+    with pure feature- / data-parallel tiling)."""
+    import jax.numpy as jnp
+    from lightgbm_tpu.learner_wave import WaveTPUTreeLearner
+
+    X, y = _problem(rng, n=4096, f=16)
+    params = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+              "min_data_in_leaf": 20, "enable_bundle": False}
+    ds = lgb.Dataset(X, label=y, params=params)
+    ds.construct()
+    data = ds.constructed
+    cfg = Config.from_params(params)
+    n_pad = data.num_data_padded
+    grad = jnp.asarray(rng.randn(n_pad).astype(np.float32))
+    hess = jnp.ones(n_pad, jnp.float32) * 0.25
+    bag = jnp.zeros(n_pad, jnp.float32).at[:len(y)].set(1.0)
+
+    serial = WaveTPUTreeLearner(cfg, data)
+    rf_s = np.asarray(serial.train_async(grad, hess, bag)[0])
+    ri_s = np.asarray(serial.train_async(grad, hess, bag)[1])
+    for shape in MESH_SHAPES:
+        mesh = _mesh2d(shape)
+        assert wave2d_ineligible_reason(cfg, data, mesh) is None
+        sharded = ShardedWave2DLearner(cfg, data, mesh)
+        rf_d, ri_d, rc_d, lid_d, lo_d = sharded.train_async(grad, hess, bag)
+        np.testing.assert_allclose(np.asarray(rf_d), rf_s, rtol=2e-4,
+                                   atol=1e-4, err_msg=f"mesh={shape}")
+        # integer bagged counts agree exactly
+        np.testing.assert_array_equal(np.asarray(ri_d), ri_s,
+                                      err_msg=f"mesh={shape}")
+
+
+def test_wave2d_model_matches_serial_and_1d_modes(rng):
+    """End-to-end boosters: the 2-D model is structurally identical to
+    serial AND to both 1-D parallel modes on the same data."""
+    X, y = _problem(rng)
+    serial = _train(X, y, "serial")
+    ref_struct = _structure(serial)
+    ref_pred = serial.predict(X)
+    others = {
+        "data": _train(X, y, "data"),
+        "feature": _train(X, y, "feature"),
+        "2d(2x4)": _train(X, y, "data_feature", mesh_shape=(2, 4)),
+    }
+    for name, bst in others.items():
+        assert _structure(bst) == ref_struct, name
+        np.testing.assert_allclose(bst.predict(X), ref_pred, rtol=1e-4,
+                                   atol=1e-5, err_msg=name)
+
+
+def test_wave2d_with_bagging_matches_data_parallel(rng):
+    """Bagging masks are seeded host-side, so 2-D and 1-D data-parallel see
+    identical bags — the models must still agree structurally."""
+    X, y = _problem(rng)
+    kw = dict(bagging_fraction=0.8, bagging_freq=1, seed=7)
+    dp = _train(X, y, "data", **kw)
+    hp = _train(X, y, "data_feature", mesh_shape=(2, 4), **kw)
+    assert isinstance(hp.gbdt.learner, ShardedWave2DLearner)
+    assert _structure(hp) == _structure(dp)
+    np.testing.assert_allclose(hp.predict(X), dp.predict(X), rtol=1e-4,
+                               atol=1e-5)
+    assert ((hp.predict(X) > 0.5) == y).mean() > 0.8
+
+
+# -- routing / config --------------------------------------------------------
+
+def test_engine_routes_data_feature_via_parallel_mesh(rng):
+    X, y = _problem(rng)
+    params = {"objective": "binary", "num_leaves": 15, "min_data_in_leaf": 5,
+              "verbosity": -1, "tree_learner": "data_feature",
+              "parallel_mesh": "2x4", "enable_bundle": False}
+    ds = lgb.Dataset(X, label=y, params=params)
+    bst = lgb.Booster(params, ds)
+    learner = bst.gbdt.learner
+    assert isinstance(learner, ShardedWave2DLearner), type(learner).__name__
+    assert (learner.Dd, learner.Df) == (2, 4)
+    for _ in range(2):
+        bst.update()
+    assert bst.gbdt.models[-1].num_leaves > 2
+
+
+def test_hybrid_alias_and_auto_mesh(rng):
+    """``tree_learner=hybrid`` aliases to data_feature; with no
+    ``parallel_mesh`` the router auto-factors the device count 2-D."""
+    cfg = Config.from_params({"tree_learner": "hybrid"})
+    assert cfg.tree_learner == "data_feature"
+
+    X, y = _problem(rng)
+    params = {"objective": "binary", "num_leaves": 15, "min_data_in_leaf": 5,
+              "verbosity": -1, "tree_learner": "hybrid",
+              "enable_bundle": False}
+    ds = lgb.Dataset(X, label=y, params=params)
+    bst = lgb.Booster(params, ds)
+    learner = bst.gbdt.learner
+    assert isinstance(learner, ShardedWave2DLearner), type(learner).__name__
+    assert learner.Dd * learner.Df == len(jax.devices())
+    assert (learner.Dd, learner.Df) == \
+        default_mesh_shape_2d(len(jax.devices()))
+
+
+def test_router_falls_back_to_1d_when_2d_ineligible(rng, capsys):
+    """An ineligible 2-D request downgrades through the 1-D data route and
+    NAMES the failed gate (round-4 verdict: no silent 10x downgrades)."""
+    X, y = _problem(rng)
+    params = {"objective": "binary", "num_leaves": 15, "min_data_in_leaf": 5,
+              "verbosity": 1, "tree_learner": "data_feature",
+              "max_bin": 300, "enable_bundle": False}
+    ds = lgb.Dataset(X, label=y, params=params)
+    bst = lgb.Booster(params, ds)
+    out = capsys.readouterr().out
+    assert "ineligible" in out
+    assert not isinstance(bst.gbdt.learner, ShardedWave2DLearner)
+    bst.update()
+    assert bst.gbdt.models[-1].num_leaves > 2
+
+
+def test_parse_mesh_shape():
+    assert parse_mesh_shape("2x4") == (2, 4)
+    assert parse_mesh_shape("4*2") == (4, 2)
+    assert parse_mesh_shape("8") == (8,)
+    assert parse_mesh_shape("") is None
+    assert parse_mesh_shape("auto") is None
+    for bad in ("0x4", "2x-1", "axb", "2x2x2"):
+        with pytest.raises(ValueError):
+            parse_mesh_shape(bad)
+
+
+def test_placement_rules_specs():
+    from jax.sharding import PartitionSpec as P
+    mesh = _mesh2d((2, 4))
+    rules = rules_for_mode("data_feature", mesh)
+    assert rules.spec_for("bins") == P(AXIS_FEATURE, AXIS_DATA)
+    assert rules.spec_for("grad") == P(AXIS_DATA)
+    assert rules.spec_for("valid_rows") == P(AXIS_DATA)
+    assert rules.spec_for("score") == P(None, AXIS_DATA)
+    flat = make_mesh()
+    assert rules_for_mode("data", flat).spec_for("bins") == \
+        P(None, AXIS_DATA)
+    # feature mode REPLICATES bins (learners slice by axis_index inside
+    # shard_map) — a sharded placement would force a reshard at the jit edge
+    assert rules_for_mode("feature", flat).spec_for("bins") == P(None, None)
+    with pytest.raises(ValueError):
+        rules_for_mode("ring", flat)
+
+
+def test_mesh_module_shims_warn():
+    """The legacy `parallel.mesh` helpers survive as deprecation shims over
+    the rules table."""
+    from lightgbm_tpu.parallel import mesh as legacy
+    with pytest.warns(DeprecationWarning):
+        legacy.row_sharding(make_mesh())
+
+
+# -- collective program shape ------------------------------------------------
+
+def test_wave2d_hlo_double_buffered_reduce_scatter(rng):
+    """With ``tpu_wave_hist_buffers=2`` the wave exchange lowers to TWO
+    independent half-wave reduce-scatters (the overlap window: group g+1's
+    accumulation has no dependence on group g's collective), not one
+    monolithic (W, F, B, 3) site."""
+    X, y = _problem(rng, n=4096, f=16)
+    params = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+              "min_data_in_leaf": 5, "enable_bundle": False,
+              "tpu_wave_hist_buffers": 2}
+    ds = lgb.Dataset(X, label=y, params=params)
+    ds.construct()
+    learner = ShardedWave2DLearner(Config.from_params(params),
+                                   ds.constructed, _mesh2d((2, 4)))
+    hlo = learner.lowered_hlo_text()
+    shapes = [tuple(int(x) for x in m.group(1).split(","))
+              for m in re.finditer(
+                  r"= f32\[([\d,]+)\][^\n]*? reduce-scatter\(", hlo)]
+    batched = [s for s in shapes if len(s) == 4 and s[0] >= 1]
+    assert len(batched) >= 2, shapes
+    # the full-width wave body splits W into two half-wave groups
+    leads = sorted(s[0] for s in batched)
+    W = learner.W
+    assert any(leads[a] + leads[b] == W
+               for a in range(len(leads)) for b in range(a + 1, len(leads))), \
+        (leads, W)
+    # and no site carries the whole wave at once
+    assert all(s[0] < W for s in batched), (leads, W)
+
+
+def test_wave2d_budget_within_1d_sum():
+    """Acceptance bar: the pinned 2-D collective-site budget must not
+    exceed the SUM of the two 1-D modes' budgets — running both layouts in
+    one program may not cost more sites than running them separately."""
+    path = os.path.join(os.path.dirname(__file__), "..", "lightgbm_tpu",
+                        "analysis", "budgets.json")
+    with open(path) as fh:
+        budgets = json.load(fh)["programs"]
+    total = lambda name: sum(budgets[name]["collectives"].values())
+    assert "wave_sharded_2d" in budgets
+    assert total("wave_sharded_2d") <= \
+        total("wave_sharded_data") + total("wave_feature")
