@@ -26,6 +26,9 @@ Usage:
       --requests 800 --qps 200 --open-seconds 5 --rows-per-request 8
   python bench_serving.py --model model.txt       # serve an existing model
   python bench_serving.py --trace-out trace.json  # capture spans too
+  python bench_serving.py --replicas 4 --protocol binary   # fleet gateway
+  python bench_serving.py --compare --out BENCH_SERVING_r02.json --round 2
+      # pickle-vs-binary x 1-vs-N replica legs (headline = binary + N)
 
 Tiny smoke (CI): --train-rows 2000 --trees 5 --requests 40 --qps 40
 --open-seconds 1.
@@ -118,7 +121,8 @@ def run_closed_loop(host, port, args) -> Dict[str, Any]:
 
     def worker(seed: int) -> None:
         rng = np.random.RandomState(1000 + seed)
-        with ServingClient(host, port, timeout=60) as c:
+        with ServingClient(host, port, timeout=60,
+                           protocol=args.protocol) as c:
             for _ in range(per_client):
                 X = _request_matrix(rng, args.rows_per_request,
                                     args.num_features)
@@ -167,7 +171,8 @@ def run_open_loop(host, port, args) -> Dict[str, Any]:
             _issue(c, X, stats, sched)
 
     for w in range(pool):
-        clients.append(ServingClient(host, port, timeout=60))
+        clients.append(ServingClient(host, port, timeout=60,
+                                     protocol=args.protocol))
     threads = [threading.Thread(target=worker, args=(w,), daemon=True)
                for w in range(pool)]
     for t in threads:
@@ -204,6 +209,17 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--deadline-ms", type=float, default=2.0)
     ap.add_argument("--max-batch-rows", type=int, default=256)
     ap.add_argument("--max-inflight", type=int, default=64)
+    ap.add_argument("--replicas", type=int, default=0,
+                    help="serve through the fleet gateway with N replicas "
+                         "(0 = legacy threaded server, -1 = one per local "
+                         "device)")
+    ap.add_argument("--protocol", choices=("auto", "binary", "pickle"),
+                    default="auto",
+                    help="client wire protocol (auto negotiates binary, "
+                         "falls back to pickle)")
+    ap.add_argument("--compare", action="store_true",
+                    help="run pickle-vs-binary x 1-vs-N replica legs in one "
+                         "process; the binary+N leg is the headline")
     ap.add_argument("--trace-out", default="",
                     help="also capture request spans (Chrome trace JSON)")
     ap.add_argument("--note", default="")
@@ -216,18 +232,50 @@ def main(argv: Optional[List[str]] = None) -> int:
     booster = build_booster(args)
     if args.num_features != booster.num_feature():
         args.num_features = booster.num_feature()
-    server = booster.serve(
-        port=0, max_batch_rows=args.max_batch_rows,
-        deadline_ms=args.deadline_ms, max_inflight=args.max_inflight,
-        trace_out=args.trace_out)
-    try:
-        closed = run_closed_loop(server.host, server.port, args)
-        open_ = run_open_loop(server.host, server.port, args)
-        section = server.stats.serving_section(
-            models=server.registry.versions(),
-            jit_entries=server.registry.jit_entries())
-    finally:
-        server.stop()
+
+    def run_leg(protocol: str, replicas: int):
+        """One (protocol, replicas) measurement with a fresh server."""
+        leg_args = argparse.Namespace(**vars(args))
+        leg_args.protocol = protocol
+        server = booster.serve(
+            replicas=replicas, port=0, max_batch_rows=args.max_batch_rows,
+            deadline_ms=args.deadline_ms, max_inflight=args.max_inflight,
+            trace_out=args.trace_out)
+        try:
+            closed = run_closed_loop(server.host, server.port, leg_args)
+            open_ = run_open_loop(server.host, server.port, leg_args)
+            # fleet servers expose the registry per replica; the legacy
+            # threaded server has a single one
+            reg = getattr(server, "registry", None) or server.replicas
+            section = server.stats.serving_section(
+                models=reg.versions(), jit_entries=reg.jit_entries())
+        finally:
+            server.stop()
+        return closed, open_, section
+
+    if args.compare:
+        n = args.replicas if args.replicas > 0 else \
+            max(len(jax.local_devices()), 2)
+        specs = [("pickle", 1), ("binary", 1), ("pickle", n), ("binary", n)]
+        legs = []
+        for proto, nrep in specs:
+            closed, open_, section = run_leg(proto, nrep)
+            legs.append({"protocol": proto, "replicas": nrep,
+                         "closed_loop": closed, "open_loop": open_})
+            print(json.dumps({"leg": f"{proto} x{nrep}",
+                              "closed_p99_ms":
+                              closed["latency_ms"]["p99"],
+                              "closed_qps": closed["qps"],
+                              "open_p99_ms": open_["latency_ms"]["p99"],
+                              "open_qps": open_["qps"]}), file=sys.stderr)
+        # the final (binary, N) leg is the headline; `section` already
+        # holds that leg's server stats
+        headline = legs[-1]
+        closed, open_ = headline["closed_loop"], headline["open_loop"]
+        args.protocol, args.replicas = headline["protocol"], n
+    else:
+        legs = None
+        closed, open_, section = run_leg(args.protocol, args.replicas)
 
     report = {
         "schema_version": 1,
@@ -243,9 +291,12 @@ def main(argv: Optional[List[str]] = None) -> int:
             "deadline_ms": args.deadline_ms,
             "max_batch_rows": args.max_batch_rows,
             "max_inflight": args.max_inflight,
+            "protocol": args.protocol,
+            "replicas": args.replicas,
         },
         "closed_loop": closed,
         "open_loop": open_,
+        **({"legs": legs} if legs else {}),
         "server": {
             "batches": section["batches"],
             "batch_occupancy": round(section["batch_occupancy"], 4),
@@ -272,6 +323,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             "open_p99_ms": report["open_loop"]["latency_ms"]["p99"],
             "open_qps": report["open_loop"]["qps"],
             "shed_rate": report["open_loop"]["shed_rate"],
+            "protocol": args.protocol,
+            "replicas": args.replicas,
             "out": args.out}
     print(json.dumps(line))
     return 0

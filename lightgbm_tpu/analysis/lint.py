@@ -6,10 +6,13 @@ to the failure classes PRs 1-4 fixed by hand.  Rules:
 
   * **LGB001-socket-timeout** — every socket this package creates
     (``socket.socket`` / ``socket.create_connection`` / ``accept()``) must
-    carry a timeout: either a ``timeout=`` argument at the call or a
-    ``settimeout`` on the result within the same function.  A blocking
-    socket with no deadline is how a dead peer becomes a silent 120 s hang
-    (the PR-4 class).
+    carry a deadline discipline: a ``timeout=`` argument at the call, a
+    ``settimeout`` on the result within the same function, or a
+    ``setblocking`` on it (a non-blocking socket on a selector loop —
+    the fleet gateway's accept path — can never park a thread; the
+    selector's own timeout is the deadline).  A blocking socket with no
+    deadline is how a dead peer becomes a silent 120 s hang (the PR-4
+    class).
   * **LGB002-atomic-write** — a function that opens a file for writing must
     either go through the temp-file idiom (``tempfile.mkstemp`` in scope)
     or publish with ``os.replace``; a plain ``open(path, "w")`` leaves a
@@ -171,8 +174,13 @@ def _scan_scope(scope: _Scope, all_scopes: List[_Scope]) -> None:
                                                      ast.Attribute):
             scope.socket_calls.append((node, "accept",
                                        _assign_target_for(node, scope.node)))
-        elif name.endswith(".settimeout") and isinstance(node.func,
-                                                         ast.Attribute):
+        elif (name.endswith(".settimeout")
+              or name.endswith(".setblocking")) and \
+                isinstance(node.func, ast.Attribute):
+            # setblocking(False) satisfies the rule the same way a
+            # timeout does: a non-blocking socket on a selector loop
+            # (serving/fleet/gateway.py) can never park a thread in
+            # recv/accept — the selector's own timeout is the deadline
             try:
                 scope.settimeout_targets.add(ast.unparse(node.func.value))
             except Exception:

@@ -47,6 +47,9 @@ DEFAULT_FILES = (
     os.path.join("serving", "batcher.py"),
     os.path.join("serving", "registry.py"),
     os.path.join("serving", "server.py"),
+    os.path.join("serving", "fleet", "wire.py"),
+    os.path.join("serving", "fleet", "gateway.py"),
+    os.path.join("serving", "fleet", "replicas.py"),
     os.path.join("io", "net.py"),
     os.path.join("reliability", "degrade.py"),
     os.path.join("reliability", "metrics.py"),

@@ -197,7 +197,13 @@ def run_serve(params: Dict[str, str], cfg: Config) -> None:
         from .reliability import faults
         faults.arm(cfg.fault_spec)
     booster = Booster(model_file=cfg.input_model, params=dict(params))
+    fleet_kwargs = {}
+    if cfg.serve_replicas:
+        # any non-zero replica count serves through the async
+        # binary-protocol gateway (serving/fleet/); -1 = per-device
+        fleet_kwargs["recovery_s"] = cfg.serve_recovery_s
     server = booster.serve(
+        replicas=cfg.serve_replicas,
         host=cfg.serve_host, port=cfg.serve_port,
         max_batch_rows=cfg.serve_max_batch_rows,
         deadline_ms=cfg.serve_deadline_ms,
@@ -207,9 +213,16 @@ def run_serve(params: Dict[str, str], cfg: Config) -> None:
         trace_out=cfg.trace_out, trace_capacity=cfg.trace_capacity,
         stats_out=cfg.serve_stats_out,
         stats_interval_s=cfg.serve_stats_interval,
-        record_rows=cfg.lifecycle_record_rows)
-    _log(f"Serving {cfg.input_model} at {server.host}:{server.port} "
-         f"(buckets {server.buckets}, deadline {cfg.serve_deadline_ms} ms)")
+        record_rows=cfg.lifecycle_record_rows, **fleet_kwargs)
+    if cfg.serve_replicas:
+        _log(f"Serving {cfg.input_model} at {server.host}:{server.port} "
+             f"with {len(server.replicas)} replica(s) "
+             f"(binary+pickle protocols, buckets {server.buckets}, "
+             f"deadline {cfg.serve_deadline_ms} ms)")
+    else:
+        _log(f"Serving {cfg.input_model} at {server.host}:{server.port} "
+             f"(buckets {server.buckets}, deadline "
+             f"{cfg.serve_deadline_ms} ms)")
     if cfg.serve_stats_out:
         _log(f"Stats snapshots every {cfg.serve_stats_interval:g}s to "
              f"{cfg.serve_stats_out}")

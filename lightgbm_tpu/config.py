@@ -455,6 +455,15 @@ class Config:
     # (aliases: stats_out / stats_interval)
     serve_stats_out: str = ""
     serve_stats_interval: float = 10.0
+    # replica fleet (lightgbm_tpu/serving/fleet/): 0 = the legacy
+    # single-replica threaded server; -1 = one replica per local device
+    # (the production default for fleet serving); N>0 = exactly N
+    # replicas round-robined over the local devices.  Any non-zero value
+    # serves through the async binary-protocol gateway (FleetServer)
+    serve_replicas: int = 0
+    # ejection cooldown: a replica whose device path failed is excluded
+    # from dispatch for this many seconds, then probed again
+    serve_recovery_s: float = 1.0
     # --- lifecycle (lightgbm_tpu/lifecycle/) ---
     # bounded live-traffic ring in the serving server: the newest this
     # many request feature rows are retained for the lifecycle shadow

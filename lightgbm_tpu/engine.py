@@ -234,20 +234,28 @@ class Booster:
 
     # -- serving (lightgbm_tpu/serving/) -------------------------------------
 
-    def to_server(self, **kwargs) -> "Any":
-        """An UNSTARTED ``PredictionServer`` with this booster registered
-        as the ``default`` model (see README "Serving").  Keyword args are
-        forwarded (host/port/max_batch_rows/deadline_ms/min_bucket/
-        warmup/max_inflight/telemetry_out, the observability knobs
-        trace/trace_out/trace_capacity/stats_out/stats_interval_s, and
-        the lifecycle traffic-ring capacity record_rows)."""
+    def to_server(self, replicas: int = 0, **kwargs) -> "Any":
+        """An UNSTARTED server with this booster registered as the
+        ``default`` model (see README "Serving").  ``replicas=0`` (the
+        default) builds the single-replica threaded ``PredictionServer``;
+        any other value builds the async binary-protocol ``FleetServer``
+        (``-1`` = one replica per local device, N>0 = exactly N).
+        Keyword args are forwarded (host/port/max_batch_rows/deadline_ms/
+        min_bucket/warmup/max_inflight/telemetry_out, the observability
+        knobs trace/trace_out/trace_capacity/stats_out/stats_interval_s,
+        and the lifecycle traffic-ring capacity record_rows)."""
+        if replicas:
+            from .serving import FleetServer
+
+            return FleetServer(booster=self,
+                               replicas=max(int(replicas), 0), **kwargs)
         from .serving import PredictionServer
 
         return PredictionServer(booster=self, **kwargs)
 
     def serve(self, **kwargs) -> "Any":
         """Start serving this booster over a socket; returns the running
-        ``PredictionServer`` (``.host``/``.port``/``.stop()``)."""
+        server (``.host``/``.port``/``.stop()``)."""
         return self.to_server(**kwargs).start()
 
     def feature_name(self) -> List[str]:

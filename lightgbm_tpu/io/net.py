@@ -87,11 +87,22 @@ def recv_frame(sock: socket.socket,
                max_bytes: int = DEFAULT_MAX_FRAME_BYTES):
     """Receive one frame.  The length prefix is UNTRUSTED input: anything
     above ``max_bytes`` raises a ``ConnectionError`` naming both numbers
-    instead of attempting the allocation."""
-    (ln,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+    instead of attempting the allocation.  A binary wire-protocol header
+    (`serving/fleet/wire.py`, magic ``LGBT``) landing here reads as a
+    ~2.2e12 length — it trips the same guard, but is named for what it
+    is so the client's pickle-fallback negotiation (and the operator) see
+    a protocol mismatch, not random corruption.  Either way the stream
+    has no resync point after a bad prefix: the caller must close."""
+    raw = _recv_exact(sock, _LEN.size)
+    (ln,) = _LEN.unpack(raw)
     f = faults.fire("net.recv.corrupt_len")
     if f is not None:
         ln = int(f.get("len", 1 << 62))
+    if raw[:4] == b"LGBT":
+        rel_inc("net.frames_rejected_protocol_mismatch")
+        raise ConnectionError(
+            "binary wire-protocol frame received on a pickle channel — "
+            "protocol mismatch (peer speaks serving/fleet/wire.py framing)")
     if max_bytes > 0 and ln > max_bytes:
         rel_inc("net.frames_rejected_oversize")
         raise ConnectionError(

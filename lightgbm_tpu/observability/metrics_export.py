@@ -148,10 +148,13 @@ def prometheus_text(counters: Optional[Dict[str, float]] = None,
     return "\n".join(lines) + "\n"
 
 
-def prometheus_snapshot(stats, registry=None, admission=None) -> str:
+def prometheus_snapshot(stats, registry=None, admission=None,
+                        replicas=None) -> str:
     """The server ``metrics`` op payload: every serving counter, stage
     timer total, reliability counter, model version and the request
-    latency histogram, as one Prometheus text page."""
+    latency histogram, as one Prometheus text page.  ``replicas`` (a
+    ``ReplicaSet.section()`` list) adds per-replica fleet gauges:
+    health, in-flight, dispatched, ejections, p99."""
     from ..reliability.metrics import rel_counters
 
     section = stats.serving_section(
@@ -187,6 +190,17 @@ def prometheus_snapshot(stats, registry=None, admission=None) -> str:
     if registry is not None:
         for name, ver in (registry.versions() or {}).items():
             gauges[f"serving_model_version:{sanitize_metric_name(name)}"] = ver
+    for snap in replicas or ():
+        i = snap["index"]
+        gauges[f"serving_replica_healthy:{i}"] = \
+            1.0 if snap["healthy"] else 0.0
+        gauges[f"serving_replica_inflight:{i}"] = snap["in_flight"]
+        counters[f"serving_replica_dispatched_total:{i}"] = \
+            snap["dispatched"]
+        counters[f"serving_replica_ejections_total:{i}"] = \
+            snap["ejections"]
+        gauges[f"serving_replica_latency_p99_ms:{i}"] = \
+            snap["latency_ms"]["p99"]
     return prometheus_text(
         counters, gauges,
         histograms={"serving_request_latency_seconds": stats.request_hist})
@@ -244,6 +258,24 @@ BENCH_SERVING_SCHEMA: Dict[str, Any] = {
         },
         "closed_loop": _LOOP_SCHEMA,
         "open_loop": _LOOP_SCHEMA,
+        # protocol/replica comparison sweeps: one entry per (protocol,
+        # replicas) leg, each a closed+open loop pair (bench_serving.py
+        # --compare); the headline closed_loop/open_loop above is the
+        # last (best-configured) leg
+        "legs": {
+            "type": "array",
+            "items": {
+                "type": "object",
+                "required": ["protocol", "replicas", "closed_loop",
+                             "open_loop"],
+                "properties": {
+                    "protocol": {"type": "string"},
+                    "replicas": {"type": "integer"},
+                    "closed_loop": _LOOP_SCHEMA,
+                    "open_loop": _LOOP_SCHEMA,
+                },
+            },
+        },
         "server": {
             "type": "object",
             "required": ["batches", "batch_occupancy", "shed",

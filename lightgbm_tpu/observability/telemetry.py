@@ -52,7 +52,10 @@ TEL_NAMES = {
 # reports / watchdog state — `lightgbm_tpu/lifecycle/controller.py`);
 # serving section gains "errors" (admitted requests answered with an
 # error frame)
-SCHEMA_VERSION = 5
+# v6: serving section gains optional "replicas" array (per-replica fleet
+# state: health, in-flight, dispatched, ejections, latency histogram —
+# `lightgbm_tpu/serving/fleet/replicas.py`)
+SCHEMA_VERSION = 6
 
 
 class Telemetry:

@@ -18,6 +18,10 @@ Spec grammar (semicolon-separated clauses)::
     net.crash:rank=1:nth=2            # rank 1 hard-exits at its 2nd collective
     serve.predict.fail:count=-1       # every device predict raises
     serve.predict.delay:seconds=0.2   # device predict stalls (overload tests)
+    serving.replica_fault:rank=1      # fleet replica 1's device path fails
+                                      # (rank = replica index; the batch
+                                      # degrades to host fallback and the
+                                      # dispatcher ejects the replica)
     train.crash:nth=3                 # kill training after its 3rd iteration
                                       # (snapshots already written — the
                                       # lifecycle kill-mid-refit seam)

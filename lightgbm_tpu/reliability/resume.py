@@ -47,7 +47,8 @@ _VOLATILE_KEYS = frozenset({
     "net_max_frame_mb", "net_collective_deadline_s",
     "serve_host", "serve_port", "serve_max_batch_rows", "serve_deadline_ms",
     "serve_min_bucket", "serve_warmup", "serve_max_inflight",
-    "serve_stats_out", "serve_stats_interval",
+    "serve_stats_out", "serve_stats_interval", "serve_replicas",
+    "serve_recovery_s",
     "trace_out", "trace_capacity",
     "lifecycle_record_rows", "lifecycle_metric", "lifecycle_metric_floor",
     "lifecycle_divergence_max", "lifecycle_latency_max_ratio",

@@ -22,6 +22,11 @@ ROADMAP north star ("serves heavy traffic from millions of users") needs:
   * ``server`` — a threaded socket server + client over the
     length-prefixed-pickle framing of ``io/net.py``, exposed as
     ``python -m lightgbm_tpu serve`` and ``Booster.serve()``.
+  * ``fleet`` — the multi-replica production front end: a typed binary
+    wire protocol (no pickle on the untrusted path), a selector-based
+    async gateway owning every client socket, least-loaded dispatch
+    across one replica per local device with health ejection, and
+    zero-drop rolling promotion (``serve_replicas`` in the CLI).
 
 Serving telemetry (QPS, queue/bin/traverse/unpad stage latency, batch
 occupancy, compile-cache hits) reports through ``observability/`` under the
@@ -35,11 +40,14 @@ _LAZY = {
     "ModelRegistry": "registry", "ServingModel": "registry",
     "PredictionServer": "server", "ServingClient": "server",
     "ServerOverloaded": "server", "ServerUnavailable": "server",
+    "FleetServer": "fleet", "ReplicaSet": "fleet", "Replica": "fleet",
+    "WireError": "fleet",
 }
 
 __all__ = ["OOV_BIN", "BinnerArrays", "MicroBatcher", "ServingStats",
            "ModelRegistry", "ServingModel", "PredictionServer",
-           "ServingClient", "ServerOverloaded", "ServerUnavailable"]
+           "ServingClient", "ServerOverloaded", "ServerUnavailable",
+           "FleetServer", "ReplicaSet", "Replica", "WireError"]
 
 
 def __getattr__(name):

@@ -184,9 +184,11 @@ class ServingStats:
 
 
 class _Request:
-    __slots__ = ("X", "n", "done", "result", "error", "t_enq", "trace_id")
+    __slots__ = ("X", "n", "done", "result", "error", "t_enq", "trace_id",
+                 "callback")
 
-    def __init__(self, X: np.ndarray, trace_id: Optional[str] = None):
+    def __init__(self, X: np.ndarray, trace_id: Optional[str] = None,
+                 callback: Optional[Callable[["_Request"], None]] = None):
         self.X = X
         self.n = X.shape[0]
         self.done = threading.Event()
@@ -196,6 +198,7 @@ class _Request:
         # the queue-wait span aligns with the stage spans
         self.t_enq = time.perf_counter()
         self.trace_id = trace_id
+        self.callback = callback
 
 
 class MicroBatcher:
@@ -270,6 +273,30 @@ class MicroBatcher:
         if req.error is not None:
             raise req.error
         return req.result
+
+    def submit_async(self, X: np.ndarray, callback: Callable[[_Request], None],
+                     trace_id: Optional[str] = None) -> _Request:
+        """Non-blocking predict: enqueue and return immediately; the batch
+        worker invokes ``callback(request)`` once ``result``/``error`` is
+        set.  This is the seam the fleet gateway's event loop rides — it
+        must never block on device work (`serving/fleet/gateway.py`), so
+        callbacks run on the batcher worker thread and must themselves be
+        non-blocking (the gateway just enqueues the response and wakes the
+        selector).  Oversize requests are the dispatcher's problem: rows
+        beyond ``max_rows`` raise here rather than silently blocking on a
+        chunk chain."""
+        X = np.ascontiguousarray(np.atleast_2d(np.asarray(X, np.float64)))
+        if X.shape[1] != self.num_features:
+            raise ValueError(f"request has {X.shape[1]} features, model "
+                             f"expects {self.num_features}")
+        if X.shape[0] > self.max_rows:
+            raise ValueError(f"async request of {X.shape[0]} rows exceeds "
+                             f"the {self.max_rows}-row batch budget; chunk "
+                             f"at the dispatch layer")
+        self.stats.record_request(X.shape[0])
+        req = _Request(X, trace_id=trace_id, callback=callback)
+        self._q.put(req)
+        return req
 
     # -- worker side ---------------------------------------------------------
 
@@ -348,8 +375,21 @@ class MicroBatcher:
                 r.result = scores[ofs:ofs + r.n]
                 ofs += r.n
                 r.done.set()
+                if r.callback is not None:
+                    self._fire_callback(r)
             self.stats.record_batch(bucket, m)
         except BaseException as e:
             for r in reqs:
                 r.error = e
                 r.done.set()
+                if r.callback is not None:
+                    self._fire_callback(r)
+
+    @staticmethod
+    def _fire_callback(r: _Request) -> None:
+        # a broken callback must not take down the batch worker (or the
+        # other riders' callbacks)
+        try:
+            r.callback(r)
+        except BaseException:
+            pass

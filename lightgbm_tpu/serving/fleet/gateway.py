@@ -1,0 +1,635 @@
+"""Selector-based async gateway fronting the replica fleet.
+
+One event-loop thread owns EVERY client socket — thousands of
+connections cost buffers, not threads (the thread-per-connection
+`serving/server.py` model tops out at the OS thread budget long before
+the device does).  The loop accepts, reads, incrementally parses frames,
+applies admission, and hands complete predict requests to
+`replicas.ReplicaSet.dispatch`; the per-replica ``MicroBatcher`` device
+workers stay threaded and respond through a cross-thread outbuf +
+socketpair wakeup, so the loop never blocks on device work and device
+work never touches a socket.
+
+Both protocols on one port: the first 4 bytes of a connection decide —
+``LGBT`` means binary wire frames (`wire.py`), anything else is the
+legacy 8-byte-length + pickle framing, so old ``ServingClient``s keep
+working unmodified.  Corrupt binary headers follow wire.py's defined
+resync-or-close behavior: an oversize length on a well-formed header
+gets a structured error frame then close; a bad magic/version closes
+immediately (no trustable frame boundary remains).
+
+Threading map (the races.py lock discipline):
+
+  * loop thread ONLY: ``_conns``, every ``_Conn.inbuf``/parser field
+  * ``_Conn.out_lock`` (leaf): ``outbuf``/``closing`` — loop + worker
+    threads
+  * ``_pending`` under ``self._pending_lock`` (leaf): conns with fresh
+    output awaiting a selector interest update, drained by the loop
+  * replica/batcher/stats state: their own locks (never held while a
+    gateway lock is)
+
+Fleet lifecycle: ``promote_rolling`` prepares a candidate on every
+replica, gates it with the PR 8 shadow validator over recorded traffic,
+then commits one replica at a time — in-flight requests ride whichever
+version their replica holds, so zero requests drop during a roll or a
+``rollback_fleet`` (the hammer test in tests/test_fleet.py pins this).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import pickle
+import selectors
+import socket
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+_NULL_CTX = contextlib.nullcontext()
+
+from ...io.net import DEFAULT_MAX_FRAME_BYTES, _LEN
+from ...lifecycle.recorder import TrafficRecorder
+from ...lifecycle.shadow import shadow_validate
+from ...observability.trace import TraceRecorder, new_trace_id
+from ...reliability.degrade import AdmissionController
+from ...reliability.metrics import rel_inc
+from ..batcher import ServingStats
+from . import wire
+from .replicas import ReplicaSet
+
+_RECV_CHUNK = 1 << 16
+
+
+class _Conn:
+    """Per-connection state.  Parser fields (``inbuf``, ``protocol``)
+    are loop-thread-only; ``outbuf``/``closing`` are shared with worker
+    threads under ``out_lock`` (a leaf lock)."""
+
+    __slots__ = ("sock", "inbuf", "outbuf", "out_lock", "protocol",
+                 "closing")
+
+    def __init__(self, sock: socket.socket):
+        self.sock = sock
+        self.inbuf = bytearray()
+        self.outbuf = bytearray()
+        self.out_lock = threading.Lock()
+        self.protocol: Optional[str] = None     # None until sniffed
+        self.closing = False                    # flush outbuf, then close
+
+
+class FleetServer:
+    """Async front end + replica fleet; drop-in surface for
+    ``PredictionServer`` (start/stop/wait/report/port) plus the fleet
+    ops (``promote_rolling``/``rollback_fleet``, per-replica stats)."""
+
+    def __init__(self, booster=None, replicas: int = 0,
+                 host: str = "127.0.0.1", port: int = 0,
+                 max_batch_rows: int = 256, deadline_ms: float = 2.0,
+                 min_bucket: int = 32, warmup: bool = True,
+                 telemetry_out: str = "", request_timeout: float = 60.0,
+                 max_inflight: int = 64, trace: bool = False,
+                 trace_out: str = "", trace_capacity: int = 65536,
+                 stats_out: str = "", stats_interval_s: float = 10.0,
+                 record_rows: int = 0, recovery_s: float = 1.0,
+                 max_frame_bytes: int = DEFAULT_MAX_FRAME_BYTES):
+        self.host = host
+        self.port = int(port)
+        self.request_timeout = float(request_timeout)
+        self.max_frame_bytes = int(max_frame_bytes)
+        self.telemetry_out = telemetry_out
+        self.admission = AdmissionController(max_inflight)
+        self.stats = ServingStats()
+        self.tracer: Optional[TraceRecorder] = None
+        if trace or trace_out:
+            self.tracer = TraceRecorder(True, capacity=trace_capacity)
+            self.stats.attach_tracer(self.tracer)
+        self.trace_out = trace_out
+        self.stats_out = stats_out
+        self.stats_interval_s = float(stats_interval_s)
+        self.recorder = TrafficRecorder(record_rows)
+        self.lifecycle = None
+        self.replicas = ReplicaSet(
+            stats=self.stats, replicas=replicas,
+            max_batch_rows=max_batch_rows, deadline_ms=deadline_ms,
+            min_bucket=min_bucket, warmup=warmup, recovery_s=recovery_s)
+        self.buckets = self.replicas.buckets
+        if booster is not None:
+            self.replicas.load("default", booster=booster)
+        self._sel: Optional[selectors.BaseSelector] = None
+        self._srv: Optional[socket.socket] = None
+        self._wake_r: Optional[socket.socket] = None
+        self._wake_w: Optional[socket.socket] = None
+        self._conns: Dict[socket.socket, _Conn] = {}
+        self._pending_lock = threading.Lock()
+        self._pending: List[_Conn] = []
+        self._thread: Optional[threading.Thread] = None
+        self._stats_thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._stopped = threading.Event()
+        self._promote_lock = threading.Lock()
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "FleetServer":
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind((self.host, self.port))
+        srv.listen(128)
+        # the selector loop IS the timeout discipline: non-blocking
+        # sockets can never park a thread in recv/accept
+        srv.setblocking(False)
+        self.port = srv.getsockname()[1]
+        self._srv = srv
+        self._wake_r, self._wake_w = socket.socketpair()
+        self._wake_r.setblocking(False)
+        self._wake_w.setblocking(False)
+        self._sel = selectors.DefaultSelector()
+        self._sel.register(srv, selectors.EVENT_READ, "accept")
+        self._sel.register(self._wake_r, selectors.EVENT_READ, "wake")
+        self._thread = threading.Thread(
+            target=self._loop, name="lgbt-fleet-gateway", daemon=True)
+        self._thread.start()
+        if self.stats_out:
+            self._stats_thread = threading.Thread(
+                target=self._stats_loop, name="lgbt-fleet-stats",
+                daemon=True)
+            self._stats_thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._stop.is_set():
+            return
+        self._stop.set()
+        self._wake()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+        self.replicas.stop()
+        if self.telemetry_out:
+            from ...observability import write_report
+            write_report(self.report(), self.telemetry_out)
+        if self.stats_out:
+            self._write_stats_snapshot()
+        if self.trace_out and self.tracer is not None:
+            self.tracer.save(self.trace_out)
+        self._stopped.set()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._stopped.wait(timeout)
+
+    def __enter__(self) -> "FleetServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- report / snapshots --------------------------------------------------
+
+    def report(self) -> Dict[str, Any]:
+        rep = self.stats.report(models=self.replicas.versions(),
+                                jit_entries=self.replicas.jit_entries())
+        rep["serving"]["replicas"] = self.replicas.section()
+        if self.lifecycle is not None:
+            rep["lifecycle"] = self.lifecycle.section()
+        return rep
+
+    def trace(self) -> Optional[Dict[str, Any]]:
+        return self.tracer.export() if self.tracer is not None else None
+
+    def _write_stats_snapshot(self) -> None:
+        from ...observability import write_report
+        try:
+            write_report(self.report(), self.stats_out)
+        except Exception as e:
+            rel_inc("serve.stats_snapshot_errors")
+            print(f"[LightGBM-TPU] [Warning] stats snapshot failed: {e}",
+                  flush=True)
+
+    def _stats_loop(self) -> None:
+        while not self._stop.wait(self.stats_interval_s):
+            self._write_stats_snapshot()
+
+    # -- fleet promotion -----------------------------------------------------
+
+    def promote_rolling(self, name: str = "default", booster=None,
+                        model_str: Optional[str] = None,
+                        model_file: Optional[str] = None,
+                        settle_s: float = 0.0,
+                        divergence_max: float = 0.25,
+                        latency_max_ratio: float = 8.0,
+                        shadow_min_rows: int = 1) -> Dict[str, Any]:
+        """Fleet-wide promotion: prepare (build+warm+verify) the
+        candidate on EVERY replica off to the side, gate replica 0's
+        prepared copy with the shadow validator over the recorded
+        traffic window, then commit one replica at a time.  Serving is
+        never interrupted: each commit is an atomic registry swap and
+        batchers resolve their model per batch.  Returns the structured
+        outcome; a failed gate commits nothing."""
+        with self._promote_lock:
+            prepared = self.replicas.prepare_all(
+                name, booster=booster, model_str=model_str,
+                model_file=model_file)
+            out: Dict[str, Any] = {"model": name,
+                                   "replicas": len(self.replicas)}
+            X = self.recorder.snapshot()
+            incumbent = None
+            try:
+                incumbent = self.replicas.get(name)
+            except KeyError:
+                pass
+            if incumbent is not None and X.shape[0] >= shadow_min_rows \
+                    and X.size:
+                shadow = shadow_validate(
+                    prepared[0], incumbent, X,
+                    divergence_max=divergence_max,
+                    latency_max_ratio=latency_max_ratio,
+                    min_rows=shadow_min_rows, buckets=self.buckets)
+                out["shadow"] = shadow
+                if not shadow["passed"]:
+                    out["committed"] = False
+                    rel_inc("serve.fleet_promotions_rejected")
+                    return out
+            else:
+                out["shadow"] = {"skipped": True,
+                                 "rows": int(X.shape[0]) if X.size else 0}
+            out["versions"] = self.replicas.commit_rolling(
+                prepared, settle_s=settle_s)
+            out["committed"] = True
+            rel_inc("serve.fleet_promotions")
+            return out
+
+    def rollback_fleet(self, name: str = "default") -> Dict[str, Any]:
+        """Re-swap every replica's retained incumbent (zero-drop for the
+        same reason the roll is)."""
+        with self._promote_lock:
+            restored = self.replicas.rollback_all(name)
+        return {"model": name, "restored": restored}
+
+    # -- event loop ----------------------------------------------------------
+
+    def _wake(self) -> None:
+        try:
+            self._wake_w.send(b"\x00")
+        except (OSError, AttributeError, BlockingIOError):
+            pass                      # full pipe still wakes the selector
+
+    def _loop(self) -> None:
+        try:
+            while not self._stop.is_set():
+                events = self._sel.select(timeout=0.25)
+                for key, mask in events:
+                    if key.data == "accept":
+                        self._accept_ready()
+                    elif key.data == "wake":
+                        self._drain_wake()
+                    else:
+                        conn: _Conn = key.data
+                        if mask & selectors.EVENT_READ:
+                            self._read_ready(conn)
+                        if mask & selectors.EVENT_WRITE and \
+                                conn.sock in self._conns:
+                            self._write_ready(conn)
+                self._apply_pending()
+        finally:
+            for conn in list(self._conns.values()):
+                self._close_conn(conn)
+            for s in (self._srv, self._wake_r, self._wake_w):
+                if s is not None:
+                    try:
+                        self._sel.unregister(s)
+                    except (KeyError, ValueError):
+                        pass
+                    try:
+                        s.close()
+                    except OSError:
+                        pass
+            self._sel.close()
+
+    def _accept_ready(self) -> None:
+        while True:
+            try:
+                sock, _addr = self._srv.accept()
+            except (BlockingIOError, InterruptedError):
+                return
+            except OSError:
+                return
+            sock.setblocking(False)
+            conn = _Conn(sock)
+            self._conns[sock] = conn
+            self._sel.register(sock, selectors.EVENT_READ, conn)
+            rel_inc("serve.fleet_connections")
+
+    def _drain_wake(self) -> None:
+        try:
+            while self._wake_r.recv(4096):
+                pass
+        except (BlockingIOError, InterruptedError):
+            pass
+        except OSError:
+            pass
+
+    def _apply_pending(self) -> None:
+        """Loop-thread: pick up conns whose outbuf gained data from a
+        worker thread and add EVENT_WRITE to their interest."""
+        with self._pending_lock:
+            pending, self._pending = self._pending, []
+        for conn in pending:
+            if conn.sock not in self._conns:
+                continue
+            self._write_ready(conn)      # try inline; registers WRITE if short
+
+    def _read_ready(self, conn: _Conn) -> None:
+        try:
+            data = conn.sock.recv(_RECV_CHUNK)
+        except (BlockingIOError, InterruptedError):
+            return
+        except OSError:
+            self._close_conn(conn)
+            return
+        if not data:
+            self._close_conn(conn)
+            return
+        conn.inbuf.extend(data)
+        try:
+            self._parse(conn)
+        except wire.WireError as e:
+            # bad magic / version / unparseable frame: no trustable
+            # frame boundary remains — close (wire.py's defined
+            # resync-or-close contract)
+            rel_inc("serve.fleet_wire_errors")
+            self._send_bytes(conn, wire.error_frame(str(e)), close=True)
+            if conn.protocol != "binary":
+                self._close_conn(conn)
+
+    def _write_ready(self, conn: _Conn) -> None:
+        if conn.sock not in self._conns:
+            return
+        with conn.out_lock:
+            buf = conn.outbuf
+            while buf:
+                try:
+                    sent = conn.sock.send(bytes(buf[:_RECV_CHUNK]))
+                except (BlockingIOError, InterruptedError):
+                    break
+                except OSError:
+                    self._close_conn(conn)
+                    return
+                del buf[:sent]
+            drained = not buf
+            closing = conn.closing
+        want = selectors.EVENT_READ if drained else \
+            selectors.EVENT_READ | selectors.EVENT_WRITE
+        try:
+            self._sel.modify(conn.sock, want, conn)
+        except (KeyError, ValueError):
+            return
+        if drained and closing:
+            self._close_conn(conn)
+
+    def _close_conn(self, conn: _Conn) -> None:
+        if self._conns.pop(conn.sock, None) is None:
+            return
+        try:
+            self._sel.unregister(conn.sock)
+        except (KeyError, ValueError):
+            pass
+        try:
+            conn.sock.close()
+        except OSError:
+            pass
+
+    # -- response path (any thread) ------------------------------------------
+
+    def _send_bytes(self, conn: _Conn, data: bytes,
+                    close: bool = False) -> None:
+        """Queue response bytes and (cross-thread) wake the selector.
+        Safe from worker threads: only touches outbuf under its leaf
+        lock and the pending list under its own."""
+        with conn.out_lock:
+            conn.outbuf.extend(data)
+            if close:
+                conn.closing = True
+        on_loop = threading.current_thread() is self._thread
+        if on_loop:
+            self._write_ready(conn)
+        else:
+            with self._pending_lock:
+                self._pending.append(conn)
+            self._wake()
+
+    def _encode_resp(self, conn: _Conn, resp: Dict[str, Any],
+                     opcode: int, trace_id: str = "") -> bytes:
+        """One response dict → this connection's framing."""
+        if conn.protocol == "pickle":
+            blob = pickle.dumps(resp, protocol=pickle.HIGHEST_PROTOCOL)
+            return _LEN.pack(len(blob)) + blob
+        if opcode == wire.OP_PREDICT and resp.get("ok"):
+            return wire.pack_frame(
+                wire.OP_PREDICT,
+                wire.encode_predict_response(resp["scores"]),
+                wire.FLAG_RESP, trace_id)
+        if resp.get("shed"):
+            return wire.shed_frame(resp.get("inflight", 0),
+                                   resp.get("capacity", 0), trace_id)
+        if not resp.get("ok", True):
+            return wire.error_frame(str(resp.get("error")), trace_id)
+        body = {k: v for k, v in resp.items() if k != "ok"}
+        return wire.pack_frame(opcode, wire.encode_json(body),
+                               wire.FLAG_RESP, trace_id)
+
+    # -- request parsing (loop thread only) ----------------------------------
+
+    def _parse(self, conn: _Conn) -> None:
+        if conn.protocol is None:
+            if len(conn.inbuf) < len(wire.MAGIC):
+                return
+            conn.protocol = "binary" \
+                if bytes(conn.inbuf[:4]) == wire.MAGIC else "pickle"
+        if conn.protocol == "binary":
+            self._parse_binary(conn)
+        else:
+            self._parse_pickle(conn)
+
+    def _parse_binary(self, conn: _Conn) -> None:
+        while len(conn.inbuf) >= wire.HEADER_SIZE:
+            opcode, flags, tid, length = wire.unpack_header(
+                bytes(conn.inbuf[:wire.HEADER_SIZE]), self.max_frame_bytes)
+            if len(conn.inbuf) < wire.HEADER_SIZE + length:
+                return
+            payload = bytes(conn.inbuf[wire.HEADER_SIZE:
+                                       wire.HEADER_SIZE + length])
+            del conn.inbuf[:wire.HEADER_SIZE + length]
+            self._handle_binary(conn, opcode, flags, tid, payload)
+            if conn.sock not in self._conns:
+                return
+
+    def _parse_pickle(self, conn: _Conn) -> None:
+        while len(conn.inbuf) >= _LEN.size:
+            (ln,) = _LEN.unpack(bytes(conn.inbuf[:_LEN.size]))
+            if self.max_frame_bytes > 0 and ln > self.max_frame_bytes:
+                rel_inc("net.frames_rejected_oversize")
+                self._close_conn(conn)
+                return
+            if len(conn.inbuf) < _LEN.size + ln:
+                return
+            blob = bytes(conn.inbuf[_LEN.size:_LEN.size + ln])
+            del conn.inbuf[:_LEN.size + ln]
+            try:
+                msg = pickle.loads(blob)
+            except Exception:
+                self._close_conn(conn)
+                return
+            self._handle_pickle(conn, msg)
+            if conn.sock not in self._conns:
+                return
+
+    # -- op dispatch ---------------------------------------------------------
+
+    def _handle_pickle(self, conn: _Conn, msg) -> None:
+        if not isinstance(msg, dict) or "op" not in msg:
+            self._send_bytes(conn, self._encode_resp(
+                conn, {"ok": False, "error": "malformed request"}, 0))
+            return
+        op = str(msg.get("op"))
+        if op == "predict":
+            X = msg.get("data")
+            self._predict(conn, wire.OP_PREDICT, np.asarray(X, np.float64),
+                          str(msg.get("model", "default")),
+                          bool(msg.get("raw_score")),
+                          msg.get("trace_id") or "")
+            return
+        self._control(conn, op, dict(msg), opcode=0)
+
+    def _handle_binary(self, conn: _Conn, opcode: int, flags: int,
+                       tid: str, payload: bytes) -> None:
+        if opcode == wire.OP_PREDICT:
+            X, name = wire.decode_predict_request(payload)
+            self._predict(conn, opcode, X, name,
+                          bool(flags & wire.FLAG_RAW_SCORE), tid)
+            return
+        msg = wire.decode_json(payload) if payload else {}
+        msg["op"] = wire.OP_NAMES.get(opcode, "?")
+        self._control(conn, msg["op"], msg, opcode=opcode, trace_id=tid)
+
+    def _control(self, conn: _Conn, op: str, msg: Dict[str, Any],
+                 opcode: int, trace_id: str = "") -> None:
+        """Non-predict ops.  Cheap ones answer inline on the loop
+        thread; slow ones (swap = prepare+warm on every replica,
+        shutdown = join worker threads) run on a side thread and respond
+        through the cross-thread outbuf."""
+        if op == "ping":
+            resp = {"ok": True, "version": wire.WIRE_VERSION}
+        elif op == "health":
+            models = self.replicas.versions()
+            healthy = sum(1 for r in self.replicas.replicas if r.healthy())
+            resp = {"ok": True,
+                    "ready": bool(models) and not self._stop.is_set(),
+                    "models": models,
+                    "versions": self.replicas.versions_detail(),
+                    "replicas": len(self.replicas),
+                    "replicas_healthy": healthy,
+                    **self.admission.snapshot()}
+        elif op == "stats":
+            resp = {"ok": True, "report": self.report()}
+        elif op == "metrics":
+            from ...observability.metrics_export import prometheus_snapshot
+            resp = {"ok": True,
+                    "text": prometheus_snapshot(
+                        self.stats, registry=self.replicas,
+                        admission=self.admission,
+                        replicas=self.replicas.section()),
+                    "content_type": "text/plain; version=0.0.4"}
+        elif op == "swap":
+            def _swap():
+                try:
+                    out = self.promote_rolling(
+                        str(msg.get("model", "default")),
+                        model_str=msg.get("model_str"),
+                        model_file=msg.get("model_file"))
+                    if out.get("committed"):
+                        r = {"ok": True, "fleet": out,
+                             "version": max(out["versions"].values())}
+                    else:
+                        r = {"ok": False, "fleet": out,
+                             "error": "candidate rejected by shadow gate"}
+                except Exception as e:
+                    r = {"ok": False,
+                         "error": f"{type(e).__name__}: {e}"}
+                self._send_bytes(conn, self._encode_resp(
+                    conn, r, opcode or wire.OP_SWAP, trace_id))
+            threading.Thread(target=_swap, name="lgbt-fleet-swap",
+                             daemon=True).start()
+            return
+        elif op == "shutdown":
+            resp = {"ok": True}
+            self._send_bytes(conn, self._encode_resp(
+                conn, resp, opcode or wire.OP_SHUTDOWN, trace_id),
+                close=True)
+            threading.Thread(target=self.stop, daemon=True).start()
+            return
+        else:
+            resp = {"ok": False, "error": f"unknown op {op!r}"}
+        self._send_bytes(conn, self._encode_resp(conn, resp,
+                                                 opcode, trace_id))
+
+    def _predict(self, conn: _Conn, opcode: int, X: np.ndarray, name: str,
+                 raw_score: bool, trace_id: str) -> None:
+        tid = trace_id or (new_trace_id() if self.tracer is not None
+                           else "")
+        if not self.admission.try_acquire():
+            self.stats.record_shed()
+            resp = {"ok": False, "error": "overloaded", "shed": True,
+                    "inflight": self.admission.inflight,
+                    "capacity": self.admission.capacity}
+            if tid:
+                resp["trace_id"] = tid
+            self._send_bytes(conn, self._encode_resp(conn, resp, opcode,
+                                                     tid))
+            return
+        t0 = time.perf_counter()
+        try:
+            X = np.atleast_2d(X)
+            self.recorder.record(X)
+            replica = self.replicas.pick()
+            model = replica.registry.get(name)
+            span = self.tracer.span(
+                "serve.request", cat="serving", trace_id=tid or None,
+                args={"model": name, "rows": int(X.shape[0]),
+                      "replica": replica.index}) \
+                if self.tracer is not None else _NULL_CTX
+
+            def _done(handle) -> None:
+                try:
+                    if handle.error is not None:
+                        self.stats.record_error()
+                        resp = {"ok": False,
+                                "error": f"{type(handle.error).__name__}: "
+                                         f"{handle.error}"}
+                    else:
+                        scores = model.convert_output(handle.result,
+                                                      raw_score)
+                        resp = {"ok": True, "scores": np.asarray(scores)}
+                    if tid:
+                        resp["trace_id"] = tid
+                    self._send_bytes(conn, self._encode_resp(
+                        conn, resp, opcode, tid))
+                finally:
+                    self.admission.release()
+                    self.stats.record_request_latency(
+                        (time.perf_counter() - t0) * 1e3)
+
+            with span:
+                replica.submit_async(X, name, _done, trace_id=tid or None)
+        except Exception as e:
+            # dispatch-time failure (unknown model, bad shape): the
+            # admission slot releases HERE because no callback will
+            self.stats.record_error()
+            self.admission.release()
+            self.stats.record_request_latency(
+                (time.perf_counter() - t0) * 1e3)
+            resp = {"ok": False, "error": f"{type(e).__name__}: {e}"}
+            if tid:
+                resp["trace_id"] = tid
+            self._send_bytes(conn, self._encode_resp(conn, resp, opcode,
+                                                     tid))

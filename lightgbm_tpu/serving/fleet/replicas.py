@@ -1,0 +1,355 @@
+"""Replica fleet: one registry+batcher stack per device, least-loaded dispatch.
+
+A ``Replica`` is the unit the fleet scales and fails by — its own
+``ModelRegistry`` pinned to one local device (`registry.ServingModel`
+``device=``), its own per-model ``MicroBatcher`` worker threads, and its
+own health state.  ``ReplicaSet`` owns N of them (default: one per
+``jax.local_devices()`` entry) and routes each admitted request to the
+healthy replica with the fewest requests in flight.
+
+Health/ejection: a device-path failure inside a replica's predict
+function — an organic device error or the ``serving.replica_fault``
+injection point (`reliability/faults.py`, matched by ``rank`` = replica
+index) — degrades THAT BATCH to the host fallback (no rider fails) and
+ejects the replica for ``recovery_s`` seconds: the dispatcher skips it,
+traffic redistributes to the survivors, and the ejection is counted.
+After the cooldown the next dispatch re-admits it (recovery probe); a
+still-faulty replica just re-ejects.  When every replica is ejected the
+set dispatches least-loaded anyway — serving degraded beats refusing.
+
+Fleet lifecycle (the PR 8 prepare/commit/rollback story across N
+registries): ``prepare_all`` builds+warms+verifies a candidate on every
+replica off to the side; ``commit_rolling`` then swaps one replica at a
+time (each registry's commit is atomic and each batcher resolves its
+model at batch time, so requests in flight during the roll are served by
+whichever version their replica holds — never dropped); ``rollback_all``
+re-swaps the retained incumbents.  The shadow-validation gate in front
+of the roll lives in `gateway.FleetServer.promote_rolling`.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ...observability.metrics_export import LatencyHistogram
+from ...reliability import faults
+from ...reliability.metrics import rel_inc
+from ..batcher import MicroBatcher, ServingStats, bucket_ladder
+from ..registry import ModelRegistry, ServingModel
+
+
+class _AggRequest:
+    """Aggregate handle for an oversize async request chunked across
+    several batcher submissions — quacks like ``batcher._Request`` for
+    the dispatch callback (``result``/``error``/``trace_id``)."""
+
+    __slots__ = ("result", "error", "trace_id", "_parts", "_left", "_lock")
+
+    def __init__(self, n_parts: int, trace_id: Optional[str]):
+        self.result = None
+        self.error: Optional[BaseException] = None
+        self.trace_id = trace_id
+        self._parts: List[Optional[np.ndarray]] = [None] * n_parts
+        self._left = n_parts
+        self._lock = threading.Lock()
+
+    def part_done(self, i: int, req) -> bool:
+        """Record chunk ``i``; True once every chunk has reported."""
+        with self._lock:
+            if req.error is not None and self.error is None:
+                self.error = req.error
+            self._parts[i] = req.result
+            self._left -= 1
+            if self._left:
+                return False
+            if self.error is None:
+                self.result = np.concatenate(self._parts, axis=0)
+            return True
+
+
+class Replica:
+    """One servable device stack with health state and load accounting."""
+
+    def __init__(self, index: int, device, stats: ServingStats,
+                 warm_buckets: Sequence[int], warmup: bool = True,
+                 max_batch_rows: int = 256, deadline_ms: float = 2.0,
+                 min_bucket: int = 32, recovery_s: float = 1.0):
+        self.index = int(index)
+        self.device = device
+        self.stats = stats
+        self.max_batch_rows = int(max_batch_rows)
+        self.deadline_ms = float(deadline_ms)
+        self.min_bucket = int(min_bucket)
+        self.recovery_s = float(recovery_s)
+        self.registry = ModelRegistry(stats=stats,
+                                      warm_buckets=list(warm_buckets),
+                                      warmup=warmup, device=device)
+        # per-replica dispatch→response latency (the fleet view; the
+        # shared ServingStats request_hist stays the aggregate).  Lock-leaf
+        self.hist = LatencyHistogram()
+        self._batchers: Dict[str, MicroBatcher] = {}
+        self._batcher_lock = threading.Lock()
+        self._lock = threading.Lock()
+        self._inflight = 0
+        self._dispatched = 0
+        self._completed = 0
+        self._errors = 0
+        self._device_failures = 0
+        self._ejections = 0
+        self._healthy_flag = True
+        self._eject_until = 0.0
+
+    # -- health --------------------------------------------------------------
+
+    def healthy(self) -> bool:
+        """Current dispatchability; an elapsed cooldown re-admits the
+        replica right here (the recovery probe is the next dispatch)."""
+        with self._lock:
+            if not self._healthy_flag and \
+                    time.monotonic() >= self._eject_until:
+                self._healthy_flag = True
+                rel_inc("serve.replica_recoveries")
+            return self._healthy_flag
+
+    def _record_device_failure(self) -> None:
+        with self._lock:
+            self._device_failures += 1
+            if self._healthy_flag:
+                self._healthy_flag = False
+                self._ejections += 1
+                self._eject_until = time.monotonic() + self.recovery_s
+                ejected = True
+            else:
+                self._eject_until = time.monotonic() + self.recovery_s
+                ejected = False
+        if ejected:
+            rel_inc("serve.replica_ejections")
+
+    @property
+    def inflight(self) -> int:
+        with self._lock:
+            return self._inflight
+
+    # -- batching ------------------------------------------------------------
+
+    def _batcher(self, name: str) -> MicroBatcher:
+        with self._batcher_lock:
+            b = self._batchers.get(name)
+            if b is None:
+                # resolve the model at BATCH time so a rolling commit is
+                # picked up atomically at the next batch boundary
+                def predict_fn(Xpad, m, _name=name):
+                    f = faults.fire("serving.replica_fault", rank=self.index)
+                    if f is not None:
+                        self._record_device_failure()
+                        raise faults.InjectedFault(
+                            f"injected serving.replica_fault on replica "
+                            f"{self.index}")
+                    try:
+                        return self.registry.get(_name).predict_padded(
+                            Xpad, m)
+                    except BaseException:
+                        self._record_device_failure()
+                        raise
+
+                def fallback_fn(Xpad, m, _name=name):
+                    return self.registry.get(_name).host_fallback(Xpad, m)
+
+                b = MicroBatcher(
+                    predict_fn,
+                    num_features=self.registry.get(name).num_features,
+                    max_batch_rows=self.max_batch_rows,
+                    deadline_ms=self.deadline_ms,
+                    min_bucket=self.min_bucket, stats=self.stats,
+                    fallback_fn=fallback_fn).start()
+                self._batchers[name] = b
+            return b
+
+    def submit_async(self, X: np.ndarray, name: str,
+                     callback: Callable[[Any], None],
+                     trace_id: Optional[str] = None) -> None:
+        """Dispatch one request to this replica's batcher without
+        blocking; ``callback(handle)`` runs on the batch worker once
+        ``handle.result``/``handle.error`` is set.  Oversize requests are
+        chunked to the batch budget and re-aggregated here (the async
+        analogue of ``MicroBatcher.submit``'s chunk chain)."""
+        X = np.ascontiguousarray(np.atleast_2d(np.asarray(X, np.float64)))
+        b = self._batcher(name)
+        with self._lock:
+            self._inflight += 1
+            self._dispatched += 1
+        t0 = time.perf_counter()
+
+        def _finish(handle) -> None:
+            with self._lock:
+                self._inflight -= 1
+                self._completed += 1
+                if handle.error is not None:
+                    self._errors += 1
+            self.hist.record((time.perf_counter() - t0) * 1e3)
+            callback(handle)
+
+        if X.shape[0] <= b.max_rows:
+            b.submit_async(X, _finish, trace_id=trace_id)
+            return
+        chunks = [X[i:i + b.max_rows] for i in range(0, X.shape[0],
+                                                     b.max_rows)]
+        agg = _AggRequest(len(chunks), trace_id)
+
+        def _chunk_cb(i):
+            def cb(req):
+                if agg.part_done(i, req):
+                    _finish(agg)
+            return cb
+
+        for i, c in enumerate(chunks):
+            b.submit_async(c, _chunk_cb(i), trace_id=trace_id)
+
+    def stop(self) -> None:
+        with self._batcher_lock:
+            batchers = list(self._batchers.values())
+        for b in batchers:
+            b.stop()
+
+    # -- observability -------------------------------------------------------
+
+    def snapshot(self) -> Dict[str, Any]:
+        # histogram + registry locks are taken BEFORE self._lock so no
+        # lock nests inside another (races.py lock-order discipline)
+        latency = self.hist.snapshot()
+        models = self.registry.versions()
+        healthy = self.healthy()
+        with self._lock:
+            return {"index": self.index,
+                    "device": str(self.device),
+                    "healthy": healthy,
+                    "in_flight": self._inflight,
+                    "dispatched": self._dispatched,
+                    "completed": self._completed,
+                    "errors": self._errors,
+                    "device_failures": self._device_failures,
+                    "ejections": self._ejections,
+                    "models": models,
+                    "latency_ms": latency}
+
+
+class ReplicaSet:
+    """N replicas + least-loaded dispatch + fleet-wide lifecycle."""
+
+    def __init__(self, stats: Optional[ServingStats] = None,
+                 replicas: int = 0, devices: Optional[Sequence] = None,
+                 max_batch_rows: int = 256, deadline_ms: float = 2.0,
+                 min_bucket: int = 32, warmup: bool = True,
+                 recovery_s: float = 1.0):
+        import jax
+        self.stats = stats or ServingStats()
+        devs = list(devices) if devices is not None else jax.local_devices()
+        n = int(replicas) if int(replicas) > 0 else len(devs)
+        self.buckets = bucket_ladder(min_bucket, max_batch_rows)
+        # replicas round-robin over devices when n > device count (CPU
+        # tests run 8 virtual devices; real fleets usually match 1:1)
+        self.replicas: List[Replica] = [
+            Replica(i, devs[i % len(devs)], self.stats, self.buckets,
+                    warmup=warmup, max_batch_rows=max_batch_rows,
+                    deadline_ms=deadline_ms, min_bucket=min_bucket,
+                    recovery_s=recovery_s)
+            for i in range(n)]
+
+    def __len__(self) -> int:
+        return len(self.replicas)
+
+    # -- dispatch ------------------------------------------------------------
+
+    def pick(self) -> Replica:
+        """Least-loaded healthy replica (lowest in-flight count, index
+        breaking ties).  With the whole fleet ejected, dispatch
+        least-loaded over everyone — degraded service beats refusal,
+        and the batcher's host fallback still answers."""
+        healthy = [r for r in self.replicas if r.healthy()]
+        pool = healthy or self.replicas
+        if not healthy:
+            rel_inc("serve.dispatch_no_healthy_replica")
+        return min(pool, key=lambda r: (r.inflight, r.index))
+
+    def dispatch(self, X: np.ndarray, name: str,
+                 callback: Callable[[Any], None],
+                 trace_id: Optional[str] = None) -> Replica:
+        r = self.pick()
+        r.submit_async(X, name, callback, trace_id=trace_id)
+        return r
+
+    # -- fleet lifecycle -----------------------------------------------------
+
+    def load(self, name: str = "default", booster=None,
+             model_str: Optional[str] = None,
+             model_file: Optional[str] = None) -> Dict[int, int]:
+        """Initial (non-rolling) load on every replica."""
+        return {r.index: r.registry.load(name, booster=booster,
+                                         model_str=model_str,
+                                         model_file=model_file)
+                for r in self.replicas}
+
+    def prepare_all(self, name: str = "default", booster=None,
+                    model_str: Optional[str] = None,
+                    model_file: Optional[str] = None) -> List[ServingModel]:
+        """Build+warm+verify a candidate on EVERY replica, off to the
+        side — serving never sees any of them until ``commit_rolling``.
+        A failure on any replica propagates with nothing swapped."""
+        return [r.registry.prepare(name, booster=booster,
+                                   model_str=model_str,
+                                   model_file=model_file)
+                for r in self.replicas]
+
+    def commit_rolling(self, prepared: Sequence[ServingModel],
+                       settle_s: float = 0.0) -> Dict[int, int]:
+        """Swap the prepared candidates in one replica at a time.  Each
+        registry commit is atomic and batchers resolve their model at
+        batch time, so during the roll a request is served by whichever
+        version its replica currently holds — old or new, never neither:
+        zero requests are dropped (the hammer test pins this).
+        ``settle_s`` optionally pauses between replicas so a canary
+        failure surfaces before the roll finishes."""
+        versions: Dict[int, int] = {}
+        for r, model in zip(self.replicas, prepared):
+            versions[r.index] = r.registry.commit(model)
+            rel_inc("serve.fleet_rolling_commits")
+            if settle_s > 0 and r is not self.replicas[-1]:
+                time.sleep(settle_s)
+        return versions
+
+    def rollback_all(self, name: str = "default") -> Dict[int, int]:
+        """Re-swap every replica's retained incumbent (reverse rolling
+        order, matching how far a partial roll got)."""
+        restored: Dict[int, int] = {}
+        for r in reversed(self.replicas):
+            restored[r.index] = r.registry.rollback(name)
+        return restored
+
+    # -- aggregate views -----------------------------------------------------
+
+    def versions(self) -> Dict[str, int]:
+        """Fleet-wide model versions (replica 0's view — the roll makes
+        them momentarily heterogeneous; ``section()`` has the per-replica
+        truth)."""
+        return self.replicas[0].registry.versions()
+
+    def versions_detail(self) -> Dict[str, Dict[str, Optional[int]]]:
+        return self.replicas[0].registry.versions_detail()
+
+    def jit_entries(self) -> Optional[int]:
+        return self.replicas[0].registry.jit_entries()
+
+    def get(self, name: str = "default") -> ServingModel:
+        return self.replicas[0].registry.get(name)
+
+    def section(self) -> List[Dict[str, Any]]:
+        """``serving.replicas[]`` for the stats report / metrics op."""
+        return [r.snapshot() for r in self.replicas]
+
+    def stop(self) -> None:
+        for r in self.replicas:
+            r.stop()

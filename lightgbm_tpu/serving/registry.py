@@ -23,6 +23,7 @@ import contextlib
 import threading
 from typing import Dict, List, Optional, Sequence
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -36,13 +37,16 @@ class ServingModel:
     """One immutable servable model version (swap = replace the object)."""
 
     def __init__(self, booster, stats: Optional[ServingStats] = None,
-                 name: str = "default", version: int = 1):
+                 name: str = "default", version: int = 1, device=None):
         from ..predictor import DevicePredictor, reconstruct_bin_schema
 
         self.booster = booster
         self.name = name
         self.version = int(version)
         self.stats = stats or ServingStats()
+        # pin this model's compute to one local device (the fleet gives
+        # each replica its own); None = jax default placement
+        self.device = device
         gbdt = booster.gbdt
         if not gbdt.models:
             raise ValueError("model has no trees to serve")
@@ -73,7 +77,12 @@ class ServingModel:
         self.stats.record_compile_cache(hit=bucket in self._warmed)
         self._warmed.add(bucket)
         with self.stats.stage("bin"):
-            xb = jnp.asarray(self.arrays.select_used(Xpad))
+            xu = self.arrays.select_used(Xpad)
+            # device_put of the committed input pulls the whole jitted
+            # bin+traverse program onto the replica's device; the cached
+            # uncommitted binner/pack constants follow placement
+            xb = jnp.asarray(xu) if self.device is None else \
+                jax.device_put(xu, self.device)
             bins = self.arrays.bin_device(xb)
             bins.block_until_ready()
         with self.stats.stage("traverse"):
@@ -133,12 +142,16 @@ class ModelRegistry:
 
     def __init__(self, stats: Optional[ServingStats] = None,
                  warm_buckets: Sequence[int] = (), warmup: bool = True,
-                 verify_rows: int = 64, verify_tol: float = 1e-5):
+                 verify_rows: int = 64, verify_tol: float = 1e-5,
+                 device=None):
         self.stats = stats or ServingStats()
         self.warm_buckets = [int(b) for b in warm_buckets]
         self.warmup = bool(warmup)
         self.verify_rows = int(verify_rows)
         self.verify_tol = float(verify_tol)
+        # every model prepared by this registry is pinned here (one
+        # registry per fleet replica); None = jax default placement
+        self.device = device
         self._lock = threading.Lock()
         self._models: Dict[str, ServingModel] = {}
         # the version each commit displaced, retained per name so
@@ -162,7 +175,8 @@ class ModelRegistry:
             version = self._models[name].version + 1 \
                 if name in self._models else 1
         tr = self.stats.tracer
-        model = ServingModel(booster, self.stats, name, version)
+        model = ServingModel(booster, self.stats, name, version,
+                             device=self.device)
         if self.warmup and self.warm_buckets:
             with (tr.span("serve.warm", cat="serving",
                           args={"buckets": list(self.warm_buckets)})

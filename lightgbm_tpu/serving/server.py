@@ -396,7 +396,16 @@ class PredictionServer:
 
 
 class ServingClient:
-    """Tiny blocking client for ``PredictionServer`` (same framing).
+    """Tiny blocking client for ``PredictionServer`` and ``FleetServer``.
+
+    Protocol: ``protocol="auto"`` (the default) probes the server ONCE
+    with a binary ``ping`` frame (`serving/fleet/wire.py`) on the first
+    connection — a fleet gateway answers in kind and the client speaks
+    compact typed binary frames from then on; a legacy pickle server
+    rejects the probe's magic as a protocol mismatch and closes, and the
+    client reconnects speaking pickle (without burning the transport
+    retry budget — negotiation is not a failure).  ``protocol="binary"``
+    / ``"pickle"`` pin the framing explicitly.
 
     Transport failures — refused/dropped connections, recv timeouts,
     torn frames — retry with bounded exponential backoff (the SocketNet
@@ -405,25 +414,57 @@ class ServingClient:
     raises.  Structured SERVER decisions are never retried blindly: a
     shed/overload frame raises ``ServerOverloaded`` immediately (the
     server is alive and explicitly refusing — hammering it back is how
-    retry storms start) and error frames raise ``RuntimeError``.
+    retry storms start) and error frames raise ``RuntimeError`` — the
+    same semantics under both framings.
     """
 
     def __init__(self, host: str, port: int, timeout: float = 60.0,
-                 retries: int = 3, backoff_s: float = 0.05):
+                 retries: int = 3, backoff_s: float = 0.05,
+                 protocol: str = "auto"):
+        if protocol not in ("auto", "binary", "pickle"):
+            raise ValueError(f"unknown protocol {protocol!r} "
+                             f"(auto, binary or pickle)")
         self._host = host
         self._port = int(port)
         self._timeout = float(timeout)
         self._retries = max(int(retries), 0)
         self._backoff_s = float(backoff_s)
+        self._protocol = protocol
+        # the negotiated framing, sticky after the first connection
+        self._wire: Optional[str] = \
+            "pickle" if protocol == "pickle" else None
         self._sock: Optional[socket.socket] = None
         self._lock = threading.Lock()
         with self._lock:
             self._connect_locked()
 
+    @property
+    def protocol(self) -> Optional[str]:
+        """The negotiated framing ("binary" or "pickle")."""
+        return self._wire
+
+    def _negotiate(self, s: socket.socket) -> bool:
+        """One-shot probe on a fresh socket: binary ping → True when the
+        server answers in wire framing.  A pickle server sees the magic
+        as a giant/mismatched length prefix and closes; that surfaces
+        here as a transport error → False (fall back), unless the caller
+        pinned ``protocol="binary"``."""
+        from .fleet import wire
+        try:
+            wire.send_wire_frame(s, wire.OP_PING)
+            opcode, _flags, _tid, payload = wire.recv_wire_frame(s)
+            wire.response_to_dict(opcode, _flags, _tid, payload)
+            return True
+        except (ConnectionError, socket.timeout, OSError, EOFError) as e:
+            if self._protocol == "binary":
+                raise ServerUnavailable(1, e) from e
+            return False
+
     def _connect_locked(self) -> None:
         """(Re)connect under ``self._lock`` with the bounded
         backoff-retry loop; transient connect errors count into the
-        reliability table."""
+        reliability table.  Protocol negotiation runs once, on the first
+        successful connection."""
         from ..reliability.metrics import rel_inc
         self._close_locked()
         backoff = self._backoff_s
@@ -433,8 +474,28 @@ class ServingClient:
                 s = socket.create_connection((self._host, self._port),
                                              timeout=self._timeout)
                 s.settimeout(self._timeout)
+                if self._wire is None:
+                    if self._negotiate(s):
+                        self._wire = "binary"
+                    else:
+                        # the probe's rejection closed the socket; the
+                        # pickle reconnect is part of negotiation, not a
+                        # transport failure
+                        self._wire = "pickle"
+                        try:
+                            s.close()
+                        except OSError:
+                            pass
+                        s = socket.create_connection(
+                            (self._host, self._port),
+                            timeout=self._timeout)
+                        s.settimeout(self._timeout)
                 self._sock = s
                 return
+            except ServerUnavailable:
+                # pinned protocol="binary" against a non-binary server:
+                # a definitive answer, not a transient to retry
+                raise
             except OSError as e:
                 last = e
                 rel_inc("serve.client_connect_retries")
@@ -452,6 +513,37 @@ class ServingClient:
                 pass
             self._sock = None
 
+    def _roundtrip_locked(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+        """One request/response exchange in the negotiated framing.
+        Binary responses are normalized into the pickle protocol's dict
+        shape so every caller above this line is protocol-blind."""
+        if self._wire != "binary":
+            send_frame(self._sock, msg)
+            return recv_frame(self._sock)
+        from .fleet import wire
+        op = msg["op"]
+        tid = msg.get("trace_id") or ""
+        if op == "predict":
+            payload = wire.encode_predict_request(
+                np.asarray(msg["data"]), msg.get("model", "default"))
+            flags = wire.FLAG_RAW_SCORE if msg.get("raw_score") else 0
+            wire.send_wire_frame(self._sock, wire.OP_PREDICT, payload,
+                                 flags, tid)
+        else:
+            opcode = {"ping": wire.OP_PING, "health": wire.OP_HEALTH,
+                      "metrics": wire.OP_METRICS, "stats": wire.OP_STATS,
+                      "swap": wire.OP_SWAP,
+                      "shutdown": wire.OP_SHUTDOWN}.get(op)
+            if opcode is None:
+                raise ValueError(f"op {op!r} has no binary encoding")
+            body = {k: v for k, v in msg.items()
+                    if k not in ("op", "trace_id")}
+            wire.send_wire_frame(self._sock, opcode,
+                                 wire.encode_json(body) if body else b"",
+                                 0, tid)
+        return wire.response_to_dict(
+            *wire.recv_wire_frame(self._sock))
+
     def _call(self, msg: Dict[str, Any]) -> Dict[str, Any]:
         from ..reliability.metrics import rel_inc
         with self._lock:
@@ -462,8 +554,7 @@ class ServingClient:
                 try:
                     if self._sock is None:
                         self._connect_locked()
-                    send_frame(self._sock, msg)
-                    resp = recv_frame(self._sock)
+                    resp = self._roundtrip_locked(msg)
                     break
                 except ServerUnavailable:
                     raise
@@ -498,7 +589,9 @@ class ServingClient:
         """Blocking predict.  ``trace_id`` (any opaque string, e.g.
         ``observability.new_trace_id()``) is carried through the server's
         request/batch/stage spans and echoed in the response — including
-        shed responses, where it lands on ``ServerOverloaded.trace_id``."""
+        shed responses, where it lands on ``ServerOverloaded.trace_id``.
+        Under the binary framing the row block ships as float32 (the
+        bandwidth win); scores come back float64."""
         msg = {"op": "predict", "model": model,
                "data": np.asarray(X, dtype=np.float64),
                "raw_score": raw_score}

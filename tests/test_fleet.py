@@ -1,0 +1,517 @@
+"""Serving fleet: binary wire protocol, selector gateway, multi-replica
+dispatch, rolling promotion with zero drops, chaos ejection/recovery."""
+
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.observability import validate_report
+from lightgbm_tpu.reliability import faults
+from lightgbm_tpu.serving import (FleetServer, ReplicaSet, ServerOverloaded,
+                                  ServerUnavailable, ServingClient, WireError)
+from lightgbm_tpu.serving.fleet import wire
+
+from test_serving import _fuzz_matrix, _host_raw, _train
+
+
+def _f32(X):
+    """Binary predict frames carry float32 rows; routing the expectation
+    through float32 too makes pickle/binary/host scores bit-comparable."""
+    return np.asarray(X, np.float64).astype(np.float32).astype(np.float64)
+
+
+@pytest.fixture(autouse=True)
+def _pristine_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+# module-scoped boosters: sharing two tree shapes across the file keeps
+# the per-test warmup compiles inside the global jit caches
+@pytest.fixture(scope="module")
+def bst_a():
+    return _train(np.random.RandomState(7), trees=8)
+
+
+@pytest.fixture(scope="module")
+def bst_b():
+    return _train(np.random.RandomState(8), trees=4, num_leaves=7,
+                  learning_rate=0.3)
+
+
+# -- wire protocol ------------------------------------------------------------
+
+def test_wire_frame_round_trip(rng):
+    X = _f32(rng.randn(7, 5))
+    payload = wire.encode_predict_request(X, "canary")
+    frame = wire.pack_frame(wire.OP_PREDICT, payload,
+                            flags=wire.FLAG_RAW_SCORE, trace_id="t-123")
+    opcode, flags, tid, length = wire.unpack_header(frame[:wire.HEADER_SIZE])
+    assert (opcode, flags, tid) == (wire.OP_PREDICT, wire.FLAG_RAW_SCORE,
+                                    "t-123")
+    assert length == len(payload)
+    Xd, name = wire.decode_predict_request(frame[wire.HEADER_SIZE:])
+    assert name == "canary" and Xd.dtype == np.float64
+    np.testing.assert_array_equal(Xd, X)      # float32 round trip is exact
+
+    scores = rng.randn(7)
+    back = wire.decode_predict_response(wire.encode_predict_response(scores))
+    np.testing.assert_array_equal(back, scores)   # scores stay float64
+
+    body = wire.decode_json(wire.encode_json({"op": "health", "n": 3}))
+    assert body == {"op": "health", "n": 3}
+
+
+def test_wire_rejects_corrupt_and_oversize():
+    good = wire.pack_frame(wire.OP_PING)
+    hdr = bytearray(good[:wire.HEADER_SIZE])
+
+    with pytest.raises(WireError):                 # wrong magic
+        wire.unpack_header(b"XXXX" + bytes(hdr[4:]))
+    bad_ver = bytearray(hdr)
+    bad_ver[4] = 99
+    with pytest.raises(WireError):                 # unknown version
+        wire.unpack_header(bytes(bad_ver))
+    bad_op = bytearray(hdr)
+    bad_op[5] = 200
+    with pytest.raises(WireError):                 # unknown opcode
+        wire.unpack_header(bytes(bad_op))
+
+    # oversize length is rejected from the 32 header bytes alone — BEFORE
+    # any payload allocation can happen
+    huge = wire.pack_frame(wire.OP_PREDICT, b"x")
+    huge = huge[:24] + (1 << 40).to_bytes(8, "little")
+    with pytest.raises(WireError):
+        wire.unpack_header(huge, max_bytes=1 << 20)
+
+    # truncated/inflated predict payloads never mis-shape the matrix
+    payload = wire.encode_predict_request(np.zeros((4, 3)))
+    with pytest.raises(WireError):
+        wire.decode_predict_request(payload[:-5])
+    with pytest.raises(WireError):
+        wire.decode_predict_request(payload + b"\0\0")
+
+
+def test_recv_frame_rejects_binary_on_pickle_channel():
+    """A binary frame hitting the legacy pickle framing is named as a
+    protocol mismatch, not misread as an absurd length prefix."""
+    from lightgbm_tpu.io.net import recv_frame
+
+    a, b = socket.socketpair()
+    try:
+        a.sendall(wire.pack_frame(wire.OP_PING))
+        b.settimeout(5)
+        with pytest.raises(ConnectionError, match="protocol mismatch"):
+            recv_frame(b)
+    finally:
+        a.close()
+        b.close()
+
+
+# -- gateway end to end -------------------------------------------------------
+
+@pytest.mark.serving
+def test_fleet_binary_end_to_end_parity(rng, bst_a):
+    bst = bst_a
+    server = bst.serve(replicas=2, port=0, max_batch_rows=64,
+                       min_bucket=32, deadline_ms=1.0)
+    try:
+        with ServingClient("127.0.0.1", server.port, timeout=60,
+                           protocol="binary") as c:
+            assert c.ping()
+            assert c.protocol == "binary"
+            for n in (3, 17, 29):
+                Xt = _f32(_fuzz_matrix(rng, n))
+                np.testing.assert_allclose(
+                    np.asarray(c.predict(Xt)).ravel(), bst.predict(Xt),
+                    rtol=0, atol=0)
+                np.testing.assert_allclose(
+                    np.asarray(c.predict(Xt, raw_score=True)).ravel(),
+                    bst.predict(Xt, raw_score=True), rtol=0, atol=0)
+            h = c.health()
+            assert h["ready"] and h["replicas"] == 2
+            assert h["replicas_healthy"] == 2
+    finally:
+        server.stop()
+
+
+@pytest.mark.serving
+def test_fleet_pickle_client_back_compat(rng, bst_a):
+    """The v1 pickle dialect still round-trips against the fleet gateway
+    on the same port (version-negotiated down, not broken)."""
+    bst = bst_a
+    server = bst.serve(replicas=2, port=0, min_bucket=64,
+                       max_batch_rows=64, deadline_ms=1.0)
+    try:
+        with ServingClient("127.0.0.1", server.port, timeout=60,
+                           protocol="pickle") as c:
+            assert c.protocol == "pickle"
+            Xt = _fuzz_matrix(rng, 12)
+            np.testing.assert_allclose(np.asarray(c.predict(Xt)).ravel(),
+                                       bst.predict(Xt), rtol=1e-6, atol=1e-6)
+            rep = c.stats()
+        assert len(rep["serving"]["replicas"]) == 2
+    finally:
+        server.stop()
+
+
+@pytest.mark.serving
+def test_auto_client_falls_back_to_pickle(rng, bst_a):
+    """Auto negotiation against the legacy threaded server: the binary
+    probe fails once, the client reconnects pinned to pickle, and the
+    fallback never burns the retry budget."""
+    bst = bst_a
+    server = bst.serve(port=0, min_bucket=64, max_batch_rows=64,
+                       deadline_ms=1.0)                       # legacy
+    try:
+        with ServingClient("127.0.0.1", server.port, timeout=60,
+                           retries=0) as c:
+            Xt = _fuzz_matrix(rng, 9)
+            np.testing.assert_allclose(np.asarray(c.predict(Xt)).ravel(),
+                                       bst.predict(Xt), rtol=1e-6, atol=1e-6)
+            assert c.protocol == "pickle"
+    finally:
+        server.stop()
+
+
+@pytest.mark.serving
+def test_fleet_shed_and_unavailable_semantics(rng, bst_a):
+    bst = bst_a
+    server = bst.serve(replicas=1, port=0, min_bucket=64, max_batch_rows=64,
+                       deadline_ms=1.0, max_inflight=1)
+    try:
+        with ServingClient("127.0.0.1", server.port, timeout=60,
+                           protocol="binary", retries=0) as c:
+            c.predict(_fuzz_matrix(rng, 4))           # warm + negotiate
+            # occupy the single admission slot (freed a hair AFTER the
+            # response bytes go out — poll), then the next request must
+            # shed as a typed binary OP_SHED frame
+            deadline = time.monotonic() + 5
+            while not server.admission.try_acquire():
+                assert time.monotonic() < deadline
+                time.sleep(0.005)
+            try:
+                with pytest.raises(ServerOverloaded):
+                    c.predict(_fuzz_matrix(rng, 4))
+            finally:
+                server.admission.release()
+            c.predict(_fuzz_matrix(rng, 4))           # and recovers
+        port = server.port
+    finally:
+        server.stop()
+    with pytest.raises(ServerUnavailable):
+        ServingClient("127.0.0.1", port, timeout=1, retries=1,
+                      backoff_s=0.01, protocol="binary").predict(
+            _fuzz_matrix(rng, 3))
+
+
+@pytest.mark.serving
+def test_corrupt_header_closes_connection_without_desync(rng, bst_a):
+    """Garbage after a valid magic closes THAT connection (the stream has
+    no resync point); the server itself keeps serving new connections."""
+    bst = bst_a
+    server = bst.serve(replicas=1, port=0, min_bucket=64, max_batch_rows=64,
+                       deadline_ms=1.0)
+    try:
+        s = socket.create_connection(("127.0.0.1", server.port), timeout=10)
+        try:
+            s.sendall(wire.MAGIC + b"\xff" * (wire.HEADER_SIZE - 4))
+            s.settimeout(10)
+            tail = b""
+            while True:                   # error frame (best effort) → EOF
+                chunk = s.recv(4096)
+                if not chunk:
+                    break
+                tail += chunk
+            if tail:
+                opcode, _, _, _ = wire.unpack_header(tail[:wire.HEADER_SIZE])
+                assert opcode == wire.OP_ERROR
+        finally:
+            s.close()
+        with ServingClient("127.0.0.1", server.port, timeout=60,
+                           protocol="binary") as c:
+            Xt = _f32(_fuzz_matrix(rng, 5))
+            np.testing.assert_allclose(np.asarray(c.predict(Xt)).ravel(),
+                                       bst.predict(Xt), rtol=0, atol=0)
+    finally:
+        server.stop()
+
+
+# -- replica dispatch ---------------------------------------------------------
+
+@pytest.mark.serving
+def test_least_loaded_dispatch_and_async_chunking(rng, bst_a):
+    bst = bst_a
+    rs = ReplicaSet(replicas=2, max_batch_rows=64, min_bucket=32,
+                    deadline_ms=1.0, warmup=False)
+    try:
+        rs.load("default", booster=bst)
+        r0, r1 = rs.replicas
+        # pick() prefers the lower in-flight count (ties → lowest index)
+        assert rs.pick() is r0
+        with r0._lock:
+            r0._inflight = 3
+        assert rs.pick() is r1
+        with r0._lock:
+            r0._inflight = 0
+
+        # an oversize request is chunked to the batch budget and the
+        # callback fires ONCE with the re-aggregated scores
+        X = _f32(_fuzz_matrix(rng, 150))
+        done = threading.Event()
+        out = {}
+
+        def cb(handle):
+            out["scores"] = handle.result
+            out["error"] = handle.error
+            done.set()
+
+        rs.dispatch(X, "default", cb)
+        assert done.wait(30)
+        assert out["error"] is None
+        np.testing.assert_allclose(np.asarray(out["scores"]).ravel(),
+                                   _host_raw(bst.gbdt, X), rtol=1e-6,
+                                   atol=1e-6)
+        snap = rs.section()
+        assert [s["index"] for s in snap] == [0, 1]
+        assert sum(s["dispatched"] for s in snap) >= 1
+    finally:
+        rs.stop()
+
+
+@pytest.mark.serving
+def test_batcher_submit_async_rejects_oversize(rng):
+    """Oversize chunking lives at the dispatch layer; the batcher's async
+    entry refuses rather than silently truncating."""
+    from lightgbm_tpu.serving import MicroBatcher, ServingStats
+
+    b = MicroBatcher(lambda Xpad, m: Xpad[:m, 0], num_features=2,
+                     max_batch_rows=32, deadline_ms=1.0, min_bucket=8,
+                     stats=ServingStats()).start()
+    try:
+        with pytest.raises(ValueError, match="dispatch layer"):
+            b.submit_async(rng.randn(100, 2), lambda h: None)
+    finally:
+        b.stop()
+
+
+# -- chaos: replica ejection and recovery -------------------------------------
+
+@pytest.mark.chaos
+def test_replica_fault_eject_survive_recover(rng, bst_a):
+    """An injected device fault on replica 0 degrades its batch to the
+    host fallback (no rider fails), ejects the replica so survivors carry
+    the traffic, and the cooldown re-admits it."""
+    bst = bst_a
+    server = bst.serve(replicas=2, port=0, min_bucket=64, max_batch_rows=64,
+                       deadline_ms=1.0, recovery_s=0.4)
+    try:
+        faults.arm("serving.replica_fault:rank=0:count=-1")
+        with ServingClient("127.0.0.1", server.port, timeout=60,
+                           protocol="binary", retries=0) as c:
+            Xt = _f32(_fuzz_matrix(rng, 6))
+            expect = bst.predict(Xt)
+            for _ in range(6):        # faulted batches degrade, never fail
+                np.testing.assert_allclose(
+                    np.asarray(c.predict(Xt)).ravel(), expect,
+                    rtol=1e-6, atol=1e-6)
+            snap = server.replicas.section()
+            assert snap[0]["ejections"] >= 1 and not snap[0]["healthy"]
+            assert snap[1]["healthy"]
+            assert c.health()["replicas_healthy"] == 1
+            # survivors carry the load while 0 is out
+            for _ in range(4):
+                c.predict(Xt)
+            assert server.replicas.section()[1]["dispatched"] >= 4
+
+            faults.disarm()
+            time.sleep(0.5)           # cooldown elapses → re-admitted
+            for _ in range(4):
+                c.predict(Xt)
+            snap = server.replicas.section()
+            assert snap[0]["healthy"]
+            assert c.health()["replicas_healthy"] == 2
+    finally:
+        faults.reset()
+        server.stop()
+
+
+# -- rolling promotion: zero drops --------------------------------------------
+
+@pytest.mark.lifecycle
+def test_rolling_promotion_zero_drops(rng, bst_a, bst_b):
+    """THE fleet lifecycle guarantee: prepare-everywhere → shadow gate →
+    per-replica rolling commit, under a retries=0 hammer across ≥2
+    replicas, with zero dropped/failed requests through promote AND
+    rollback."""
+    bst1, bst2 = bst_a, bst_b
+    server = bst1.serve(replicas=2, port=0, min_bucket=64, max_batch_rows=64,
+                        deadline_ms=1.0, record_rows=64)
+    stop = threading.Event()
+    failures = []
+    counts = [0] * 4
+
+    def hammer(wid):
+        rng_w = np.random.RandomState(300 + wid)
+        try:
+            with ServingClient("127.0.0.1", server.port, timeout=60,
+                               protocol="binary" if wid % 2 else "pickle",
+                               retries=0) as c:
+                while not stop.is_set():
+                    X = _f32(rng_w.randn(5, 4))
+                    s = np.asarray(c.predict(X)).ravel()
+                    assert s.shape == (5,) and np.all(np.isfinite(s))
+                    counts[wid] += 1
+        except BaseException as e:       # noqa: BLE001 — the assertion
+            failures.append((wid, repr(e)))
+
+    try:
+        # seed the traffic ring so the shadow gate has rows to replay
+        with ServingClient("127.0.0.1", server.port, timeout=60) as c:
+            for _ in range(4):
+                c.predict(_f32(rng.randn(8, 4)))
+
+        threads = [threading.Thread(target=hammer, args=(i,), daemon=True)
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        time.sleep(0.3)
+
+        out = server.promote_rolling(model_str=bst2.model_to_string(),
+                                     settle_s=0.05, divergence_max=1e9,
+                                     latency_max_ratio=1e9)
+        assert out["committed"], out
+        assert out["shadow"].get("skipped") or out["shadow"]["passed"]
+        assert server.replicas.versions() == {"default": 2}
+        # every replica committed (section() has the per-replica truth)
+        assert all(s["models"] == {"default": 2}
+                   for s in server.replicas.section())
+
+        time.sleep(0.3)                          # serve on v2 under load
+        back = server.rollback_fleet()
+        assert set(back["restored"].values()) == {1}
+        assert server.replicas.versions() == {"default": 1}
+
+        time.sleep(0.2)
+        stop.set()
+        for t in threads:
+            t.join(30)
+        assert failures == [], failures
+        assert min(counts) > 0, counts           # every client made progress
+
+        # post-rollback scores are v1's again
+        with ServingClient("127.0.0.1", server.port, timeout=60,
+                           protocol="binary") as c:
+            Xt = _f32(_fuzz_matrix(rng, 10))
+            np.testing.assert_allclose(np.asarray(c.predict(Xt)).ravel(),
+                                       bst1.predict(Xt), rtol=0, atol=0)
+    finally:
+        stop.set()
+        server.stop()
+
+
+@pytest.mark.lifecycle
+def test_rolling_promotion_shadow_gate_rejects(rng, bst_a, bst_b):
+    """A candidate that diverges past the gate is rejected on replica 0's
+    PREPARED copy — the serving registries never see it."""
+    bst1, bst2 = bst_a, bst_b
+    server = bst1.serve(replicas=2, port=0, min_bucket=64, max_batch_rows=64,
+                        deadline_ms=1.0, record_rows=64)
+    try:
+        with ServingClient("127.0.0.1", server.port, timeout=60) as c:
+            for _ in range(4):
+                c.predict(_f32(rng.randn(8, 4)))
+        out = server.promote_rolling(model_str=bst2.model_to_string(),
+                                     divergence_max=0.0)   # nothing passes
+        assert not out["committed"]
+        assert out["shadow"] and not out["shadow"]["passed"]
+        assert server.replicas.versions() == {"default": 1}
+    finally:
+        server.stop()
+
+
+@pytest.mark.serving
+def test_fleet_swap_over_the_wire_is_rolling(rng, bst_a, bst_b):
+    """The wire `swap` op routes through the same rolling promotion."""
+    bst1, bst2 = bst_a, bst_b
+    server = bst1.serve(replicas=2, port=0, min_bucket=64, max_batch_rows=64,
+                        deadline_ms=1.0)
+    try:
+        with ServingClient("127.0.0.1", server.port, timeout=60,
+                           protocol="binary") as c:
+            Xt = _f32(_fuzz_matrix(rng, 10))
+            np.testing.assert_allclose(np.asarray(c.predict(Xt)).ravel(),
+                                       bst1.predict(Xt), rtol=0, atol=0)
+            assert c.swap(bst2.model_to_string()) == 2
+            np.testing.assert_allclose(np.asarray(c.predict(Xt)).ravel(),
+                                       bst2.predict(Xt), rtol=0, atol=0)
+            with pytest.raises(RuntimeError):
+                c.swap("garbage")
+    finally:
+        server.stop()
+
+
+# -- observability ------------------------------------------------------------
+
+@pytest.mark.serving
+def test_fleet_report_schema_and_metrics(rng, bst_a):
+    bst = bst_a
+    server = bst.serve(replicas=2, port=0, min_bucket=64, max_batch_rows=64,
+                       deadline_ms=1.0)
+    try:
+        with ServingClient("127.0.0.1", server.port, timeout=60,
+                           protocol="binary") as c:
+            for n in (4, 11):
+                c.predict(_f32(_fuzz_matrix(rng, n)))
+            rep = c.stats()
+            text = c.metrics()
+    finally:
+        server.stop()
+    assert validate_report(rep) == []
+    reps = rep["serving"]["replicas"]
+    assert len(reps) == 2
+    for i, r in enumerate(reps):
+        assert r["index"] == i and r["healthy"]
+        assert set(r) >= {"in_flight", "dispatched", "completed",
+                          "ejections", "latency_ms"}
+    assert sum(r["dispatched"] for r in reps) >= 2
+    assert "lgbt_serving_replica_healthy:0 1" in text
+    assert "lgbt_serving_replica_healthy:1 1" in text
+    assert "lgbt_serving_replica_dispatched_total:0" in text
+
+
+@pytest.mark.analysis
+def test_lint_covers_selector_accept_path():
+    """LGB001 treats setblocking(False) like settimeout on the gateway's
+    non-blocking accept path, and still fires on a bare socket."""
+    import os
+    import tempfile
+
+    from lightgbm_tpu.analysis.lint import lint_file
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    gw = os.path.join(root, "lightgbm_tpu", "serving", "fleet", "gateway.py")
+    assert [f for f in lint_file(gw) if "LGB001" in f.rule] == []
+
+    bare = ("import socket\n"
+            "def leak(addr):\n"
+            "    s = socket.create_connection(addr)\n"
+            "    return s.recv(1)\n")
+    ok = ("import socket\n"
+          "def loop(addr):\n"
+          "    s = socket.create_connection(addr)\n"
+          "    s.setblocking(False)\n"
+          "    return s\n")
+    with tempfile.TemporaryDirectory() as d:
+        for name, src, expect in (("bare.py", bare, 1), ("ok.py", ok, 0)):
+            p = os.path.join(d, name)
+            with open(p, "w") as fh:
+                fh.write(src)
+            got = [f for f in lint_file(p, traced=False)
+                   if "LGB001" in f.rule]
+            assert len(got) == expect, (name, got)
