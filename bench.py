@@ -32,6 +32,9 @@ Usage: ``python bench.py``          — both scales, one JSON line.
        ``metric`` string.
        ``--parallel-mesh SHAPE``     — mesh-shape passthrough ("8", "2x4";
        data×feature for data_feature).
+       ``--quantized-grad MODE``     — ``tpu_quantized_grad`` passthrough
+       (on/off/auto) so quantized-vs-f32 A/B legs land as driver-captured
+       JSON lines (BENCH_r08); recorded in the ``metric`` string.
 """
 
 import gc
@@ -110,6 +113,7 @@ def main():
     telemetry_out, argv = _pop_opt_arg(sys.argv[1:], "--telemetry-out")
     tree_learner, argv = _pop_opt_arg(argv, "--tree-learner")
     parallel_mesh, argv = _pop_opt_arg(argv, "--parallel-mesh")
+    quantized, argv = _pop_opt_arg(argv, "--quantized-grad")
     telem = telemetry_out is not None
     extra = {}
     mode_tag = ""
@@ -119,6 +123,9 @@ def main():
     if parallel_mesh:
         extra["parallel_mesh"] = parallel_mesh
         mode_tag += f", mesh={parallel_mesh}"
+    if quantized:
+        extra["tpu_quantized_grad"] = quantized
+        mode_tag += f", quantized_grad={quantized}"
     reports = {}
     if argv:  # single-scale profiling mode
         rows = int(argv[0])

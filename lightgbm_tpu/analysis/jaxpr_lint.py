@@ -51,6 +51,12 @@ PROGRAM_FILES = {
     # scan) — traced in interpret mode off-TPU, which exercises the same
     # jaxpr structure the TPU path compiles
     "wave_serial_pallas": "lightgbm_tpu/ops/partition_pallas.py",
+    # round-8 quantized-gradient programs: the serial step with int8/int16
+    # discretization, and the data-sharded step whose histogram exchange
+    # rides the int16 wire tier (ops/quant.py) — its psum_scatter payload
+    # is pinned at HALF the f32 program's (checked pairwise in run())
+    "wave_serial_quant": "lightgbm_tpu/ops/quant.py",
+    "wave_sharded_data_quant": "lightgbm_tpu/parallel/compact_sharded.py",
     "wave_sharded_data": "lightgbm_tpu/parallel/wave_sharded.py",
     "wave_sharded_voting": "lightgbm_tpu/parallel/wave_sharded.py",
     "wave_feature": "lightgbm_tpu/parallel/feature_sharded.py",
@@ -82,6 +88,7 @@ def collect_stats(closed_jaxpr) -> Dict[str, Any]:
     import numpy as np
 
     collectives: Dict[str, int] = {}
+    collective_bytes: Dict[str, int] = {}
     banned: List[str] = []
     f64_ops = 0
     eqns = 0
@@ -90,6 +97,21 @@ def collect_stats(closed_jaxpr) -> Dict[str, Any]:
         name = eqn.primitive.name
         if name in COLLECTIVE_PRIMS:
             collectives[name] = collectives.get(name, 0) + 1
+            # wire payload per execution of this site: the input operands'
+            # aval bytes (per-device shapes under shard_map).  This is what
+            # the int16 histogram-exchange tier shrinks — the site COUNT
+            # stays identical, the bytes halve.
+            nb = 0
+            for iv in eqn.invars:
+                aval = getattr(iv, "aval", None)
+                shape = getattr(aval, "shape", None)
+                dt = getattr(aval, "dtype", None)
+                if shape is not None and dt is not None:
+                    size = 1
+                    for d in shape:
+                        size *= int(d)
+                    nb += size * np.dtype(dt).itemsize
+            collective_bytes[name] = collective_bytes.get(name, 0) + nb
         if any(b in name for b in BANNED_SUBSTRINGS):
             banned.append(name)
         for ov in eqn.outvars:
@@ -99,7 +121,8 @@ def collect_stats(closed_jaxpr) -> Dict[str, Any]:
                 break
     const_bytes = sum(int(getattr(c, "nbytes", 0))
                       for c in closed_jaxpr.consts)
-    return {"eqns": eqns, "collectives": collectives, "banned": banned,
+    return {"eqns": eqns, "collectives": collectives,
+            "collective_bytes": collective_bytes, "banned": banned,
             "f64_ops": f64_ops, "const_bytes": const_bytes}
 
 
@@ -119,6 +142,16 @@ def lint_program(name: str, closed_jaxpr, budget: Dict[str, Any],
                 "jaxpr", "collective-budget", file,
                 f"program {name!r} traces {count} {prim} site(s), budget "
                 f"allows {cap} — a new collective must raise "
+                f"analysis/budgets.json explicitly", symbol=name))
+    byte_caps: Dict[str, int] = dict(budget.get("collective_bytes", {}))
+    for prim, cap in sorted(byte_caps.items()):
+        traced = int(stats["collective_bytes"].get(prim, 0))
+        if traced > int(cap):
+            findings.append(Finding(
+                "jaxpr", "collective-payload", file,
+                f"program {name!r} traces {traced} {prim} payload bytes, "
+                f"budget pins {cap} — a payload regression (e.g. the int16 "
+                f"exchange tier silently falling back to f32) must raise "
                 f"analysis/budgets.json explicitly", symbol=name))
     for prim in stats["banned"]:
         findings.append(Finding(
@@ -200,7 +233,25 @@ def _trace_wave_serial_pallas():
         learner.bins_packed(), z, z, z, fmask)
 
 
-def _trace_wave_sharded(kind: str):
+def _trace_wave_serial_quant():
+    import jax
+    import jax.numpy as jnp
+
+    from ..config import Config
+    from ..learner_wave import WaveTPUTreeLearner
+
+    ds = _toy_dataset(512, 4, dict(_BASE_PARAMS))
+    learner = WaveTPUTreeLearner(
+        Config.from_params(dict(_BASE_PARAMS, tpu_quantized_grad="on")),
+        ds.constructed)
+    assert learner._quant, learner._quant_reason
+    z = jnp.zeros(ds.constructed.num_data_padded, jnp.float32)
+    fmask = jnp.ones(learner.num_features, bool)
+    return jax.make_jaxpr(learner._train_tree_wave)(
+        learner.bins_packed(), z, z, z, fmask)
+
+
+def _trace_wave_sharded(kind: str, quant: bool = False):
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
@@ -215,8 +266,13 @@ def _trace_wave_sharded(kind: str):
     params = dict(_BASE_PARAMS, enable_bundle=False)
     ds = _toy_dataset(2048, 8, params)
     mesh = make_mesh(2)
-    cfg = Config.from_params(dict(params, tree_learner={
-        "data": "data", "voting": "voting", "feature": "feature"}[kind]))
+    cfg_params = dict(params, tree_learner={
+        "data": "data", "voting": "voting", "feature": "feature"}[kind])
+    if quant:
+        # 2048 global rows keep the int16 exchange tier active
+        # (HMAX·N <= 32767, ops/quant.py)
+        cfg_params["tpu_quantized_grad"] = "on"
+    cfg = Config.from_params(cfg_params)
     if kind == "feature":
         learner = FeatureShardedWaveLearner(cfg, ds.constructed, mesh)
         body = learner._train_tree_feature_wave
@@ -230,6 +286,9 @@ def _trace_wave_sharded(kind: str):
         ax = learner.axis
         in_specs = (P(None, ax), P(ax), P(ax), P(ax), P())
         out_specs = (P(), P(), P(), P(ax), P())
+    if quant:
+        assert learner._quant, learner._quant_reason
+        assert learner._wire_int16(), "int16 exchange tier did not engage"
     kw = dict(mesh=mesh, in_specs=in_specs, out_specs=out_specs)
     try:
         fn = shard_map(body, check_vma=False, **kw)
@@ -328,11 +387,14 @@ def program_builders(need_mesh_of: int = 2
     builders: Dict[str, Callable[[], Any]] = {
         "wave_serial": _trace_wave_serial,
         "wave_serial_pallas": _trace_wave_serial_pallas,
+        "wave_serial_quant": _trace_wave_serial_quant,
         "serving_bin": _trace_serving_bin,
         "serving_traverse": _trace_serving_traverse,
     }
     if len(jax.devices()) >= need_mesh_of:
         builders["wave_sharded_data"] = lambda: _trace_wave_sharded("data")
+        builders["wave_sharded_data_quant"] = \
+            lambda: _trace_wave_sharded("data", quant=True)
         builders["wave_sharded_voting"] = \
             lambda: _trace_wave_sharded("voting")
         builders["wave_feature"] = lambda: _trace_wave_sharded("feature")
@@ -375,6 +437,23 @@ def run(budgets: Optional[Dict[str, Any]] = None,
                               max_const, x64_off)
         findings.extend(fs)
         stats[name] = st
+    # paired payload check: the quantized data-sharded program's histogram
+    # exchange must move at most HALF the f32 program's bytes (the int16
+    # wire tier's whole point); checked structurally so a silent fallback
+    # to the f32 path fails the gate even before budgets are re-pinned
+    qs = stats.get("wave_sharded_data_quant")
+    fs32 = stats.get("wave_sharded_data")
+    if qs is not None and fs32 is not None:
+        qb = int(qs["collective_bytes"].get("psum_scatter", 0))
+        fb = int(fs32["collective_bytes"].get("psum_scatter", 0))
+        if fb and 2 * qb > fb:
+            findings.append(Finding(
+                "jaxpr", "quant-exchange-payload",
+                PROGRAM_FILES["wave_sharded_data_quant"],
+                f"quantized data-sharded histogram exchange traces {qb} "
+                f"psum_scatter payload bytes, more than half the f32 "
+                f"program's {fb} — the int16 wire tier is not engaging",
+                symbol="wave_sharded_data_quant"))
     return findings, stats, skipped
 
 
@@ -390,7 +469,9 @@ def budgets_from_stats(stats: Dict[str, Dict[str, Any]],
         "max_const_bytes": int(max_const_bytes),
         "programs": {
             name: {"collectives": dict(sorted(
-                st["collectives"].items()))}
+                st["collectives"].items())),
+                "collective_bytes": dict(sorted(
+                    st["collective_bytes"].items()))}
             for name, st in sorted(stats.items())
         },
     }

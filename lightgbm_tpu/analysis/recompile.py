@@ -82,7 +82,8 @@ class RecompileSentinel:
 
 # -- the gate pass -----------------------------------------------------------
 
-def _tiny_booster(n: int = 256, f: int = 4, iters: int = 2):
+def _tiny_booster(n: int = 256, f: int = 4, iters: int = 2,
+                  extra: Optional[Dict[str, Any]] = None):
     import numpy as np
 
     import lightgbm_tpu as lgb
@@ -92,6 +93,8 @@ def _tiny_booster(n: int = 256, f: int = 4, iters: int = 2):
     y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(float)
     params = {"objective": "binary", "num_leaves": 7, "min_data_in_leaf": 5,
               "verbosity": -1}
+    if extra:
+        params.update(extra)
     ds = lgb.Dataset(X, label=y, params=params)
     bst = lgb.Booster(params, ds)
     for _ in range(iters):
@@ -126,6 +129,18 @@ def run() -> Tuple[List[Finding], Dict[str, Any], Optional[str]]:
     jits = _learner_jits(learner)
     for name, fn in jits.items():
         sentinel.register(name, fn, "lightgbm_tpu/learner_wave.py")
+
+    # -- quantized training step (tpu_quantized_grad=on): the per-round
+    # scales ride TRACE-TIME attributes (learner_wave._init_root_wave) —
+    # a value-dependent leak there would retrace the warmed step on every
+    # boosting round, exactly the regression class this sentinel exists for
+    bstq = _tiny_booster(iters=2, extra={"tpu_quantized_grad": "on"})
+    if getattr(bstq.gbdt.learner, "_quant", False):
+        for name, fn in _learner_jits(bstq.gbdt.learner).items():
+            sentinel.register(f"quant_{name}", fn,
+                              "lightgbm_tpu/ops/quant.py")
+    else:
+        bstq = None
 
     # -- 2D hybrid training step (tree_learner=data_feature on a 2x2
     # mesh): the warmed wave program must not retrace across steady-state
@@ -171,6 +186,9 @@ def run() -> Tuple[List[Finding], Dict[str, Any], Optional[str]]:
     snap = sentinel.arm()
     for _ in range(2):
         bst.update()                         # same shapes: must not retrace
+    if bstq is not None:
+        for _ in range(2):
+            bstq.update()                    # warmed quantized step likewise
     if bst2 is not None:
         for _ in range(2):
             bst2.update()                    # warmed 2D wave step likewise

@@ -531,6 +531,28 @@ class Config:
     # constraints / categorical features / feature penalties); "on"
     # forces it (interpret off-TPU); "off" = the XLA path
     tpu_wave_pallas_scan: str = "auto"
+    # quantized-gradient training (ops/quant.py — the LightGBM
+    # "Quantized Training of GBDT" recipe, NeurIPS 2022): per-round int8
+    # gradient / int16 hessian discretization with stochastic rounding
+    # and power-of-two scales; histograms carry dequantized lanes (exact
+    # in bf16, halving the Pallas expansion work), the sharded learners'
+    # hist exchange packs to int16 words (<= half the f32 payload), split
+    # gains rescale at scan time and leaf outputs are renewed from the
+    # retained f32 gradients.  The count channel becomes a Sigma-hq
+    # hessian-mass proxy, so min_data_in_leaf gates approximately —
+    # split STRUCTURE may differ from the f32 path on ties.  "on" =
+    # enable where eligible (ops/quant.py:quant_ineligible_reason);
+    # "auto" = currently OFF pending the on-hardware sweep (ROADMAP
+    # item 1; BENCH_r08 records the CPU evidence); "off" = never
+    tpu_quantized_grad: str = "auto"
+    # cross-iteration buffer donation: gradient/hessian inputs enter the
+    # per-tree program with jax.jit donate_argnums, so iteration N+1
+    # reuses iteration N's HBM instead of fresh allocations (the score
+    # array already donates through _score_add_leaf).  Trees are
+    # bit-identical either way.  "auto" = on-TPU only (CPU gains nothing
+    # and donation muddies buffer inspection when debugging); "on"/"off"
+    # force it
+    tpu_donate_buffers: str = "auto"
     # pipelined flush depth: a queued iteration's host tree is assembled
     # once it is this many iterations old (device execution has long
     # finished), so host assembly overlaps device compute instead of

@@ -142,6 +142,13 @@ class CompactTPUTreeLearner(TPUTreeLearner):
         self._hist_nterms = prec_map[cfg.tpu_hist_precision]
         self._sort_cutoff = int(cfg.tpu_sort_cutoff)
         self._acc = jnp.float64 if self.hist_dp else jnp.float32
+        # quantized-gradient mode (ops/quant.py) is a WAVE-learner gate
+        # (_init_wave_dims); the default here keeps the shared histogram
+        # branches on the f32 path for the sequential compact learner
+        self._quant = False
+        self._q_inv = None      # (1/sg, 1/sh) — traced, set per tree
+        self._q_cnt = None      # 1/(sh·m̄) count rescale — traced
+        self._q_mbar = None     # m̄ mean hess mass per bagged row
         self._jit_tree_c = jax.jit(self._train_tree_compact)
 
     # -- packed bins ---------------------------------------------------------
@@ -176,6 +183,17 @@ class CompactTPUTreeLearner(TPUTreeLearner):
     def _global_scalar(self, v):
         """Scalar reduction seam; the sharded learner psums."""
         return v
+
+    def _global_max(self, v):
+        """Elementwise max-reduction seam (quantization scale derivation);
+        the sharded learner pmaxes."""
+        return v
+
+    def _global_row_offset(self):
+        """This shard's offset into the GLOBAL row order — the stateless
+        stochastic-rounding hash keys on global row indices so every
+        device quantizes its rows exactly as the serial learner would."""
+        return jnp.int32(0)
 
     def _reduce_hist(self, local_hist):
         """Histogram exchange seam; the sharded learner reduce-scatters."""
@@ -218,6 +236,20 @@ class CompactTPUTreeLearner(TPUTreeLearner):
             # within its ancestor's window
             m = (pos >= off) & (pos < off + cnt) & (lid == leaf)
             wm = ww * m[None, :].astype(ww.dtype)
+            if self._quant:
+                # quantized lanes: TWO channels ride the contraction and
+                # the count channel is synthesized as Σhq/m̄ = Σhd ·
+                # (1/(sh·m̄)) (normalized hessian mass — see ops/quant.py);
+                # _q_cnt is a trace-time attribute set per boosting round
+                if self._use_pallas:
+                    h = build_histogram_packed(bw, wm, num_bins=b,
+                                               quant=True)[:f]
+                else:
+                    bu = unpack_bin_words(bw, f)
+                    h2 = build_histogram_onehot(bu, wm[:2], num_bins=b)
+                    h = jnp.concatenate([h2, h2[:, :, 1:2]], axis=2)
+                return h * jnp.stack([jnp.float32(1.0), jnp.float32(1.0),
+                                      self._q_cnt])
             if self._use_pallas:
                 h = build_histogram_packed(bw, wm, num_bins=b,
                                            nterms=self._hist_nterms)[:f]

@@ -191,7 +191,27 @@ class WaveTPUTreeLearner(CompactTPUTreeLearner):
         else:
             self._use_scan = False
             self._scan_interpret = False
-        self._jit_tree_w = jax.jit(self._train_tree_wave)
+        self._jit_tree_w = (
+            jax.jit(self._train_tree_wave, donate_argnums=(1, 2))
+            if self._donate else jax.jit(self._train_tree_wave))
+
+    def _fused_ok(self) -> bool:
+        """Whether this learner runs the fused hist→subtract→fix→scan
+        chain (``ops/scan_pallas.py:fused_child_scans``).  Quant mode
+        only (the packed-histogram layout is what makes one kernel pay),
+        and only where BOTH the batched scan path and the serial member
+        hists apply — the sharded subclasses interpose a collective
+        between the member hists and the scans, which the fused kernel
+        cannot straddle."""
+        from .ops.scan_pallas import fused_scan_ineligible_reason
+        return (self._quant and getattr(self, "_use_scan", False)
+                and self._bundle is None and not self._ablate
+                and type(self)._cand_rows_batch
+                is WaveTPUTreeLearner._cand_rows_batch
+                and type(self)._wave_member_hists
+                is WaveTPUTreeLearner._wave_member_hists
+                and fused_scan_ineligible_reason(
+                    self.num_features, self._hist_nbins) is None)
 
     def _init_wave_dims(self, cfg: Config) -> None:
         """Wave sizing/bookkeeping shared by the serial and sharded wave
@@ -276,6 +296,45 @@ class WaveTPUTreeLearner(CompactTPUTreeLearner):
             self._partition_interpret = False
         if self._use_partition:
             self._defer_sorts = False
+        # quantized-gradient training (Config.tpu_quantized_grad): int8
+        # gradient / int16 hessian discretization with stochastic rounding
+        # (ops/quant.py — the LightGBM quantized-training recipe).  Set
+        # HERE, not in __init__: the 2-D sharded learner re-runs
+        # _init_wave_dims without ever entering WaveTPUTreeLearner's
+        # __init__, and every wave learner must agree on the gate
+        from .ops.quant import quant_ineligible_reason
+        qg = str(getattr(cfg, "tpu_quantized_grad", "auto"))
+        # gate on the GLOBAL padded row count (a reduced histogram bin can
+        # hold every row), not the shard-local window the wave sizing uses
+        q_reason = quant_ineligible_reason(self.n_pad, self.hist_dp)
+        if qg == "on":
+            self._quant = q_reason is None
+        else:
+            # auto stays OFF until the on-hardware win is recorded
+            # (BENCH_r08 carries the CPU evidence; ROADMAP item 1 tracks
+            # the TPU leg) — same posture scan/partition auto took before
+            # their device sweeps landed
+            self._quant = False
+            if q_reason is None:
+                q_reason = "tpu_quantized_grad=%s (quantization is " \
+                           "opt-in)" % qg
+        self._quant_reason = None if self._quant else q_reason
+        self._q_inv = None
+        self._q_scales = None
+        self._q_raw = None
+        self._q_cnt = None
+        self._q_mbar = None
+        # cross-iteration buffer donation (Config.tpu_donate_buffers):
+        # grad/hess enter the tree program donated so iteration N+1 reuses
+        # iteration N's HBM; auto = on-TPU only (the CPU backend gains
+        # nothing and donation muddies interpret-mode debugging)
+        dn = str(getattr(cfg, "tpu_donate_buffers", "auto"))
+        self._donate = dn == "on" or (dn == "auto" and _on_tpu())
+        if str(getattr(cfg, "boosting", "gbdt")) == "rf":
+            # random forest refits from ONE retained gradient set every
+            # iteration (rf.py keeps _rf_grad across iters); donating
+            # those buffers would invalidate them after the first tree
+            self._donate = False
         # dev-only phase ablation for profiling (profile_wave_phases.py):
         # comma-set of {nohist, noscan, nosort} — NOT a user knob; a leaked
         # env var would silently train WRONG trees, so warn loudly
@@ -345,14 +404,58 @@ class WaveTPUTreeLearner(CompactTPUTreeLearner):
         n, L, M, H = self._rows_len(), self.num_leaves, self.M, self.H
         acc = self._acc
         self._coll_ctx = ("root", "tree")
-        w = jnp.stack([grad * bag, hess * bag, bag], axis=0)
+        if self._quant:
+            # per-round discretization (ops/quant.py): power-of-two
+            # scales from the GLOBAL |g|/h maxima, stochastic rounding
+            # keyed on the global row index.  The weight lanes carry the
+            # DEQUANTIZED values gq*sg / hq*sh — exact in bf16, so the
+            # Pallas quant hist path and sibling subtraction stay
+            # bit-exact — and the scale tuple rides trace-time attributes
+            # that the hist-branch closures read within this same trace.
+            from .ops.quant import quantize_gradients
+            gb = (grad * bag).astype(jnp.float32)
+            hb = (hess * bag).astype(jnp.float32)
+            mx = self._global_max(jnp.stack([jnp.max(jnp.abs(gb)),
+                                             jnp.max(hb)]))
+            gd, hd, sg, sh = quantize_gradients(
+                gb, hb, bag, self._global_row_offset(), mx[0], mx[1])
+            self._q_scales = (sg, sh)
+            self._q_inv = (1.0 / sg, 1.0 / sh)
+            self._q_raw = (gb, hb)     # retained f32 for leaf renewal
+            w = jnp.stack([gd, hd, bag], axis=0)
+            # count-channel normalization, BEFORE any histogram builds
+            # (the branch closures read _q_cnt): the channel carries
+            # Σhq/m̄ — hessian mass over the mean mass per bagged row —
+            # so min_data_in_leaf keeps its row-count scale (raw Σhq
+            # admits ~m̄× smaller leaves and the trees grow much deeper,
+            # see ops/quant.py).  All three sums are exact integer
+            # multiples of their scale within the F32_EXACT_ROWS gate,
+            # so m̄ and every derived rescale are order-independent and
+            # the sharded learners stay record-exact.
+            q_tot = self._global_scalar(jnp.stack(
+                [jnp.sum(gd.astype(acc)), jnp.sum(hd.astype(acc)),
+                 jnp.sum(bag.astype(acc))]))
+            mbar = jnp.maximum(q_tot[1] * self._q_inv[1], 1.0) \
+                / jnp.maximum(q_tot[2], 1.0)
+            self._q_mbar = mbar
+            self._q_cnt = self._q_inv[1] / mbar
+        else:
+            w = jnp.stack([grad * bag, hess * bag, bag], axis=0)
         lid0 = jnp.zeros(n, jnp.int32)
         root_hist = self._reduce_hist(
             self._hist_branches[-1](bins_p, w, lid0, jnp.int32(0),
                                     jnp.int32(n), jnp.int32(0)))
-        sum_g = self._global_scalar(jnp.sum((grad * bag).astype(acc)))
-        sum_h = self._global_scalar(jnp.sum((hess * bag).astype(acc)))
-        cnt = self._global_scalar(jnp.sum(bag.astype(acc)))
+        if self._quant:
+            # root totals from the DEQUANTIZED lanes so FixHistogram's
+            # totals-minus-others algebra matches the histogram contents;
+            # the count total rides the same normalized Σhq/m̄ scale as
+            # the histogram count channel
+            sum_g, sum_h = q_tot[0], q_tot[1]
+            cnt = (sum_h * self._q_cnt).astype(acc)
+        else:
+            sum_g = self._global_scalar(jnp.sum((grad * bag).astype(acc)))
+            sum_h = self._global_scalar(jnp.sum((hess * bag).astype(acc)))
+            cnt = self._global_scalar(jnp.sum(bag.astype(acc)))
         md = int(self.cfg.max_depth)
         depth_ok = jnp.asarray([True if md <= 0 else md > 0])
         cf, ci, cb = self._cand_rows_batch(
@@ -399,12 +502,19 @@ class WaveTPUTreeLearner(CompactTPUTreeLearner):
 
     def _children_bookkeeping(self, st, wi, valid, lslot, rslot, lc_bag,
                               c_bag, li, ri, lh, rh, hists2, feature_mask,
-                              phys_l=None, phys_r=None):
+                              phys_l=None, phys_r=None, fused_parts=None):
         """Shared by the wave body (K=W) and the stall split (K=1): writes
         all per-child node state given the children's histograms.
         ``phys_l/phys_r`` are the children's materialized covering spans
         (default: the logical windows — correct whenever the caller's rows
-        are physically compacted, as in the stall split)."""
+        are physically compacted, as in the stall split).
+
+        ``fused_parts`` (quant fused mode): ``(h_small, ph, left_small,
+        lh_w, rh_w)`` — the caller computed ONLY the smaller-child
+        histograms and ``hists2`` is None; sibling subtraction, the
+        default-bin fix and both child split scans run inside one Pallas
+        kernel here (``ops/scan_pallas.py:fused_child_scans``), which
+        also hands back the raw child histograms for the pool writes."""
         if phys_l is None:
             phys_l, phys_r = li, ri
         acc = self._acc
@@ -451,6 +561,32 @@ class WaveTPUTreeLearner(CompactTPUTreeLearner):
                 .at[:, CF_LSH].set(sh2 / 2).at[:, CF_RSH].set(sh2 / 2)
             ci2 = jnp.zeros((2 * K, NUM_CI), jnp.int32).at[:, CI_THR].set(127)
             cb2 = jnp.zeros((2 * K, self.cat_W), jnp.uint32)
+        elif fused_parts is not None:
+            from .learner import _FeatCand
+            from .ops.scan_pallas import fused_child_scans
+            h_small, ph_k, left_small, lh_w, rh_w = fused_parts
+            h_par = st.hist_pool[ph_k]
+            kw = {k: v for k, v in self._split_kwargs.items()
+                  if k != "skip_missing_scan"}
+            num, hl, hr = fused_child_scans(
+                h_small, h_par, left_small, sg2, sh2, cn2,
+                self.f_num_bin, self.f_missing, self.f_default_bin,
+                feature_mask & self._cat_mask,
+                interpret=self._scan_interpret, **kw)
+            st = st._replace(
+                hist_pool=st.hist_pool.at[lh_w].set(hl).at[rh_w].set(hr))
+            f = self.num_features
+            cands = _FeatCand(
+                gain=num.gain, threshold=num.threshold,
+                default_left=num.default_left,
+                is_cat=jnp.zeros((2 * K, f), bool),
+                cat_bits=jnp.zeros((2 * K, f, self.cat_W), jnp.uint32),
+                left_sum_g=num.left_sum_g, left_sum_h=num.left_sum_h,
+                left_cnt=num.left_cnt, right_sum_g=num.right_sum_g,
+                right_sum_h=num.right_sum_h, right_cnt=num.right_cnt,
+                left_output=num.left_output,
+                right_output=num.right_output)
+            cf2, ci2, cb2 = self._pack_cand_rows(cands, depth_ok)
         else:
             cf2, ci2, cb2 = self._cand_rows_batch(
                 hists2, sg2, sh2, cn2, feature_mask, depth_ok, constraints)
@@ -807,20 +943,33 @@ class WaveTPUTreeLearner(CompactTPUTreeLearner):
         lh_w = jnp.where(valid, ph, oobh)
         rh_w = jnp.where(valid, rh, oobh)
 
-        if opening:
-            # sm_start/sm_cnt reference LOGICAL windows (nothing has been
-            # compacted yet) — opening hists mask by lid over the full array
-            pool, hl, hr = self._opening_hists(
-                st, sm_slot, valid, ph, lh_w, rh_w, left_small)
+        if not opening and getattr(self, "_use_fused", False):
+            # fused chain: only the smaller-child histograms run here —
+            # subtraction, select, FixHistogram and both child scans
+            # collapse into one Pallas launch in _children_bookkeeping
+            h_small = self._member_small_hists(st, sm_slot, sm_start,
+                                               sm_cnt, valid)
+            st = self._children_bookkeeping(
+                st, wi, valid, lslot, rslot, lc_bag, c_bag, li, ri2, ph,
+                rh, None, feature_mask, phys_l, phys_r,
+                fused_parts=(h_small, ph, left_small, lh_w, rh_w))
         else:
-            pool, hl, hr = self._wave_member_hists(
-                st, sm_slot, sm_start, sm_cnt, valid, ph, lh_w, rh_w,
-                left_small)
-        st = st._replace(hist_pool=pool)
-        hists2 = jnp.stack([hl, hr], 1).reshape((2 * W,) + hl.shape[1:])
-        st = self._children_bookkeeping(
-            st, wi, valid, lslot, rslot, lc_bag, c_bag, li, ri2, ph, rh,
-            hists2, feature_mask, phys_l, phys_r)
+            if opening:
+                # sm_start/sm_cnt reference LOGICAL windows (nothing has
+                # been compacted yet) — opening hists mask by lid over
+                # the full array
+                pool, hl, hr = self._opening_hists(
+                    st, sm_slot, valid, ph, lh_w, rh_w, left_small)
+            else:
+                pool, hl, hr = self._wave_member_hists(
+                    st, sm_slot, sm_start, sm_cnt, valid, ph, lh_w, rh_w,
+                    left_small)
+            st = st._replace(hist_pool=pool)
+            hists2 = jnp.stack([hl, hr], 1).reshape((2 * W,)
+                                                   + hl.shape[1:])
+            st = self._children_bookkeeping(
+                st, wi, valid, lslot, rslot, lc_bag, c_bag, li, ri2, ph,
+                rh, hists2, feature_mask, phys_l, phys_r)
         if st.telem is not None:
             st = st._replace(telem=st.telem
                              .at[TEL_WAVES].add(1)
@@ -835,6 +984,31 @@ class WaveTPUTreeLearner(CompactTPUTreeLearner):
         # previous deferring wave included), not just this wave's children
         return st._replace(phys_i=jnp.where(sorted_now, st.node_i,
                                             st.phys_i))
+
+    def _member_small_hists(self, st: WaveState, sm_slot, sm_start, sm_cnt,
+                            valid):
+        """Smaller-child histograms ONLY (no subtraction / pool writes) —
+        the fused wave step (``_use_fused``) folds everything downstream
+        into the ``fused_child_scans`` kernel."""
+        if self._use_pallas:
+            return self._segment_hists(st, sm_slot, sm_start, sm_cnt,
+                                       valid)
+
+        def hist_member(carry, xs):
+            slot, start, cnt, vk = xs
+
+            def compute(_):
+                hidx = self._bucket_idx(jnp.maximum(cnt, 1))
+                return lax.switch(hidx, self._hist_branches, st.bins_p,
+                                  st.w_p, st.lid_p, start, cnt, slot)
+
+            return carry, lax.cond(
+                vk, compute, lambda _: jnp.zeros_like(st.hist_pool[0]),
+                0)
+
+        _, h_small = lax.scan(hist_member, 0,
+                              (sm_slot, sm_start, sm_cnt, valid))
+        return h_small
 
     def _wave_member_hists(self, st: WaveState, sm_slot, sm_start, sm_cnt,
                            valid, ph, lh_w, rh_w, left_small):
@@ -906,7 +1080,11 @@ class WaveTPUTreeLearner(CompactTPUTreeLearner):
             h_small = build_histogram_multislot(
                 st.bins_p, st.w_p, slot_r, num_bins=self._hist_nbins,
                 n_slots=K, row_block=self._seg_rb,
-                nterms=self._hist_nterms)[:, :self._hist_cols]
+                nterms=self._hist_nterms,
+                quant=self._quant)[:, :self._hist_cols]
+            if self._quant:
+                h_small = h_small * jnp.stack(
+                    [jnp.float32(1.0), jnp.float32(1.0), self._q_cnt])
             h_par = st.hist_pool[ph]
             h_large = h_par - h_small
             lsm = left_small[:, None, None, None]
@@ -986,14 +1164,20 @@ class WaveTPUTreeLearner(CompactTPUTreeLearner):
                 return build_histogram_segments(
                     bins_p, w_p, lid_p, s_t[:Ti], b_t[:Ti], l_t[:Ti],
                     num_bins=self._hist_nbins, n_slots=W, row_block=rb,
-                    nterms=self._hist_nterms)
+                    nterms=self._hist_nterms, quant=self._quant)
             return branch
 
         tarr = jnp.asarray(Ts, dtype=jnp.int32)
         idx = jnp.maximum(jnp.sum(tarr >= total) - 1, 0)
         out = lax.switch(idx, [make_branch(t) for t in Ts], slot_t, block_t,
                          leaf_t, st.bins_p, st.w_p, st.lid_p)
-        return out[:, :self._hist_cols]
+        h = out[:, :self._hist_cols]
+        if self._quant:
+            # quant kernels duplicate the h lane into the count channel;
+            # rescale it to the normalized Σhq/m̄ effective row count
+            h = h * jnp.stack([jnp.float32(1.0), jnp.float32(1.0),
+                               self._q_cnt])
+        return h
 
     def _wave_step(self, st: WaveState, feature_mask) -> WaveState:
         """One adaptive-width wave.  The ramp (frontier 1→2→4→…) and the
@@ -1620,6 +1804,7 @@ class WaveTPUTreeLearner(CompactTPUTreeLearner):
 
     def _train_tree_wave(self, bins_p, grad, hess, bag, feature_mask):
         self._ledger.begin_trace()
+        self._use_fused = self._fused_ok()
         self._hist_branches = [self._make_hist_branch(S)
                                for S in self._win_sizes]
         self._stall_branches = [
@@ -1716,6 +1901,59 @@ class WaveTPUTreeLearner(CompactTPUTreeLearner):
         leaf_out = jnp.zeros(self.num_leaves, jnp.float32).at[
             jnp.where(final, refidx, self.num_leaves + 7)].set(
                 st.node_f[:, LF_OUT].astype(jnp.float32))
+        if self._quant and self._q_raw is not None:
+            # leaf-output RENEWAL (the quantized-training recipe's
+            # accuracy anchor): per-leaf sums re-accumulated from the
+            # RETAINED f32 gradients over the final leaf assignment, so
+            # leaf values carry no discretization error — only the split
+            # STRUCTURE sees quantized sums.  Patches both the score
+            # update (leaf_out) and the host records' child outputs.
+            from .ops.split import calculate_leaf_output
+            gb, hb = self._q_raw
+            self._q_raw = None
+            L = self.num_leaves
+            kw = self._split_kwargs
+            # FIXED-POINT accumulation: the renewed outputs feed the score,
+            # and the next round's stochastic rounding keys on the score's
+            # BIT PATTERN — a 1-ulp f32 summation-order difference between
+            # serial and sharded would re-roll the rounding and fork the
+            # tree stream.  Rounding each row to a pow2 grid and summing
+            # int32 makes the reduction exact at any shard order; the grid
+            # leaves k = 30 - ceil_log2(N) bits per row (>= 9 bits under
+            # the F32_EXACT_ROWS gate), noise far below the quantization
+            # the splits already tolerate.
+            sg, sh = self._q_scales
+            kb = max(30 - int(self.n_pad - 1).bit_length(), 1)
+            qg = sg * jnp.float32(2.0 ** (3 - kb))    # sg·GMAX <= sg·2^3
+            qh = sh * jnp.float32(2.0 ** (4 - kb))    # sh·HMAX <= sh·2^4
+            rg = jnp.rint(gb / qg).astype(jnp.int32)
+            rh = jnp.rint(hb / qh).astype(jnp.int32)
+            lgh = jnp.zeros((2, L), jnp.int32) \
+                .at[0, leaf_id].add(rg).at[1, leaf_id].add(rh)
+            lgh = self._global_scalar(lgh)
+            lg = lgh[0].astype(jnp.float32) * qg
+            lh = lgh[1].astype(jnp.float32) * qh
+            has_h = lh > 0.0
+            refined = jnp.where(
+                has_h,
+                calculate_leaf_output(
+                    lg, lh, kw["lambda_l1"], kw["lambda_l2"],
+                    kw["max_delta_step"]).astype(jnp.float32),
+                0.0)
+            leaf_out = jnp.where(has_h, refined, leaf_out)
+            # pop i's left child keeps ref pop_ref[i]; its right child is
+            # ref 1 + i (the replay's leaf numbering)
+            lref = jnp.clip(pop_ref, 0, L - 1)
+            rref = jnp.minimum(jnp.arange(budget, dtype=jnp.int32) + 1,
+                               L - 1)
+            from .learner import REC_LEFT_OUT, REC_RIGHT_OUT
+            rec_f = rec_f \
+                .at[:, REC_LEFT_OUT].set(
+                    jnp.where(vp & has_h[lref], refined[lref],
+                              rec_f[:, REC_LEFT_OUT])) \
+                .at[:, REC_RIGHT_OUT].set(
+                    jnp.where(vp & has_h[rref], refined[rref],
+                              rec_f[:, REC_RIGHT_OUT]))
         if st.telem is not None:
             return rec_f, rec_i, rec_cat, leaf_id, leaf_out, st.telem
         return rec_f, rec_i, rec_cat, leaf_id, leaf_out

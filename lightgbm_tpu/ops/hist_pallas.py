@@ -186,7 +186,27 @@ def _reduce_mixed(part, nterms):
     return jnp.stack([g, h, c], axis=-2)
 
 
-def _radix_word(wt, word, rb: int, bp: int, nterms: int):
+def _expand_terms_quant(w_blk):
+    """Quantized-gradient expansion (ops/quant.py): the grad/hess lanes
+    are power-of-two-scaled small integers, EXACT in bf16 — one term per
+    lane and NO count row (the count channel is synthesized from the
+    hessian lane: Σhq hessian-mass proxy, rescaled by 1/sh outside the
+    kernel).  TWO MXU rows instead of 2·nterms+1, with zero
+    representation error."""
+    return w_blk[0:2].astype(jnp.bfloat16)              # (2, Rb)
+
+
+def _reduce_quant(part):
+    """(.., 2, B) quant partials → (.., 3, B) channels; the count channel
+    carries the hessian lane (Σhq·sh — the caller's 1/sh rescale recovers
+    the integer hessian mass)."""
+    g = part[..., 0, :]
+    h = part[..., 1, :]
+    return jnp.stack([g, h, h], axis=-2)
+
+
+def _radix_word(wt, word, rb: int, bp: int, nterms: int,
+                quant: bool = False):
     """One packed word's 4 sub-feature histogram partials via a TWO-LEVEL
     bin decomposition (the TPU analogue of the OpenCL kernels' bin-size
     specialization, `src/treelearner/ocl/histogram16.cl` vs `256.cl`):
@@ -204,7 +224,7 @@ def _radix_word(wt, word, rb: int, bp: int, nterms: int):
     blocks — the lane dimension stays 32 end-to-end (Mosaic cannot
     shape-cast across lanes), so callers accumulate into a
     (…, 4·HI, 32) output and flatten to bins OUTSIDE the kernel."""
-    nt = 2 * nterms + 1
+    nt = wt.shape[0]
     hi_n = bp // 32
     iota_hi = jax.lax.broadcasted_iota(jnp.int32, (hi_n, rb), 0)
     iota_lo = jax.lax.broadcasted_iota(jnp.int32, (32, rb), 0)
@@ -226,6 +246,9 @@ def _radix_word(wt, word, rb: int, bp: int, nterms: int):
         blk = part[s * nt * hi_n:(s + 1) * nt * hi_n,
                    s * 32:(s + 1) * 32]         # (nt*HI, 32)
         b3 = blk.reshape(nt, hi_n, 32)          # leading split only
+        if quant:
+            outs.append(jnp.stack([b3[0], b3[1], b3[1]]))  # (3, HI, 32)
+            continue
         g = b3[0:nterms].sum(axis=0)
         h = b3[nterms:2 * nterms].sum(axis=0)
         outs.append(jnp.stack([g, h, b3[2 * nterms]]))   # (3, HI, 32)
@@ -233,7 +256,8 @@ def _radix_word(wt, word, rb: int, bp: int, nterms: int):
 
 
 def _hist_kernel_packed(bins_ref, w_ref, out_ref, *, num_bins_padded: int,
-                        word_tile: int, nterms: int, radix: bool = False):
+                        word_tile: int, nterms: int, radix: bool = False,
+                        quant: bool = False):
     # ONE dot per word: the 4 sub-features' one-hots concatenate along the
     # output axis and the bf16 terms stack along the channel axis, so each
     # word costs a single (3*nterms, Rb) x (Rb, 4*B) MXU contraction
@@ -248,17 +272,20 @@ def _hist_kernel_packed(bins_ref, w_ref, out_ref, *, num_bins_padded: int,
     w_blk = w_ref[...]  # (3, Rb) f32
     rb = w_blk.shape[1]
     bp = num_bins_padded
-    if radix and nterms > 0:
-        wt = _expand_terms_mixed(w_blk, nterms)
+    if radix and (nterms > 0 or quant):
+        wt = _expand_terms_quant(w_blk) if quant \
+            else _expand_terms_mixed(w_blk, nterms)
         hi_n = bp // 32
         for wd in range(word_tile):
-            accs = _radix_word(wt, bins_ref[wd, :], rb, bp, nterms)
+            accs = _radix_word(wt, bins_ref[wd, :], rb, bp, nterms,
+                               quant=quant)
             for s in range(4):
                 out_ref[wd, :, s * hi_n:(s + 1) * hi_n, :] += accs[s]
         return
     iota_b = jax.lax.broadcasted_iota(jnp.int32, (bp, rb), 0)
-    if nterms > 0:
-        wt = _expand_terms_mixed(w_blk, nterms)  # (2*nterms+1, Rb)
+    if nterms > 0 or quant:
+        wt = _expand_terms_quant(w_blk) if quant \
+            else _expand_terms_mixed(w_blk, nterms)  # (2*nterms+1, Rb)
         for wd in range(word_tile):
             word = bins_ref[wd, :]  # (Rb,) int32
             ohs = [(((word >> (8 * s)) & 0xFF)[None, :] == iota_b)
@@ -267,7 +294,8 @@ def _hist_kernel_packed(bins_ref, w_ref, out_ref, *, num_bins_padded: int,
             part = jax.lax.dot_general(
                 wt, oh, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32)  # (2*nterms+1, 4B)
-            out_ref[wd, :, :] += _reduce_mixed(part, nterms)
+            out_ref[wd, :, :] += _reduce_quant(part) if quant \
+                else _reduce_mixed(part, nterms)
     else:  # nterms == 0: full f32 emulation (tpu_hist_precision=highest)
         for wd in range(word_tile):
             word = bins_ref[wd, :]
@@ -283,11 +311,12 @@ def _hist_kernel_packed(bins_ref, w_ref, out_ref, *, num_bins_padded: int,
 
 @functools.partial(jax.jit, static_argnames=("num_bins", "word_tile",
                                              "row_block", "nterms",
-                                             "radix", "interpret"))
+                                             "radix", "quant", "interpret"))
 def build_histogram_packed(bins_words: jax.Array, w: jax.Array, *,
                            num_bins: int, word_tile: int = 2,
                            row_block: int = 2048, nterms: int = 2,
                            radix: Optional[bool] = None,
+                           quant: bool = False,
                            interpret: bool = False) -> jax.Array:
     """hist[f,b,c] = Σ_r [byte(bins_words[f//4,r], f%4)==b] · w[c,r].
 
@@ -296,13 +325,18 @@ def build_histogram_packed(bins_words: jax.Array, w: jax.Array, *,
     w          : (3, S) f32 — (g·m, h·m, m), already masked; channel 2
                  MUST be a {0,1} bag mask (the mixed bf16 term expansion
                  gives the count channel one exact term).
+    quant      : quantized-gradient mode (ops/quant.py): w rows 0/1 are
+                 pow2-scaled integers (bf16-exact, one term each), row 2
+                 is ignored and the count channel returns Σ(h lane) — the
+                 caller rescales it by 1/sh to the Σhq hessian-mass
+                 proxy.
     Returns (Fw*4, num_bins, 3) f32.
     """
     fw, s = bins_words.shape
     word_tile, rb, b_pad = _tile_params(fw, s, word_tile, row_block,
                                         num_bins)
     if radix is None:
-        radix = nterms > 0 and b_pad % 32 == 0
+        radix = (nterms > 0 or quant) and b_pad % 32 == 0
     grid = (fw // word_tile, s // rb)
     in_specs = [
         pl.BlockSpec((word_tile, rb), lambda i, j: (i, j)),
@@ -321,7 +355,8 @@ def build_histogram_packed(bins_words: jax.Array, w: jax.Array, *,
         out_shape = jax.ShapeDtypeStruct((fw, 3, 4 * b_pad), jnp.float32)
     out = pl.pallas_call(
         functools.partial(_hist_kernel_packed, num_bins_padded=b_pad,
-                          word_tile=word_tile, nterms=nterms, radix=radix),
+                          word_tile=word_tile, nterms=nterms, radix=radix,
+                          quant=quant),
         grid=grid,
         in_specs=in_specs,
         out_specs=out_specs,
@@ -356,7 +391,7 @@ def build_histogram_packed(bins_words: jax.Array, w: jax.Array, *,
 def _hist_kernel_segment(slot_ref, block_ref, leaf_ref, bins_ref, w_ref,
                          lid_ref, out_ref, *, num_bins_padded: int,
                          word_tile: int, nterms: int, n_slots: int,
-                         radix: bool = False):
+                         radix: bool = False, quant: bool = False):
     t = pl.program_id(1)
     slot = slot_ref[t]
     prev = slot_ref[jnp.maximum(t - 1, 0)]
@@ -374,29 +409,34 @@ def _hist_kernel_segment(slot_ref, block_ref, leaf_ref, bins_ref, w_ref,
         w_blk = w_ref[...] * m                      # (3, Rb) masked
         rb = w_blk.shape[1]
         bp = num_bins_padded
-        if radix and nterms > 0:
-            wt = _expand_terms_mixed(w_blk, nterms)
+        if radix and (nterms > 0 or quant):
+            wt = _expand_terms_quant(w_blk) if quant \
+                else _expand_terms_mixed(w_blk, nterms)
             hi_n = bp // 32
             for wd in range(word_tile):
-                accs = _radix_word(wt, bins_ref[wd, :], rb, bp, nterms)
+                accs = _radix_word(wt, bins_ref[wd, :], rb, bp, nterms,
+                                   quant=quant)
                 for sf in range(4):
                     out_ref[0, wd, :, sf * hi_n:(sf + 1) * hi_n, :] += \
                         accs[sf]
             return
         iota_b = jax.lax.broadcasted_iota(jnp.int32, (bp, rb), 0)
-        if nterms > 0:
+        if quant:
+            wt = _expand_terms_quant(w_blk)          # (2, Rb)
+        elif nterms > 0:
             wt = _expand_terms_mixed(w_blk, nterms)  # (2*nterms+1, Rb)
         for wd in range(word_tile):
             word = bins_ref[wd, :]
-            ohdt = jnp.bfloat16 if nterms > 0 else jnp.float32
+            ohdt = jnp.bfloat16 if (nterms > 0 or quant) else jnp.float32
             ohs = [(((word >> (8 * s)) & 0xFF)[None, :] == iota_b)
                    .astype(ohdt) for s in range(4)]
             oh = jnp.concatenate(ohs, axis=0)       # (4B, Rb)
-            if nterms > 0:
+            if nterms > 0 or quant:
                 part = jax.lax.dot_general(
                     wt, oh, (((1,), (1,)), ((), ())),
                     preferred_element_type=jnp.float32)  # (2*nterms+1, 4B)
-                acc = _reduce_mixed(part, nterms)
+                acc = _reduce_quant(part) if quant \
+                    else _reduce_mixed(part, nterms)
             else:
                 acc = jax.lax.dot_general(
                     w_blk, oh, (((1,), (1,)), ((), ())),
@@ -407,7 +447,7 @@ def _hist_kernel_segment(slot_ref, block_ref, leaf_ref, bins_ref, w_ref,
 
 @functools.partial(jax.jit, static_argnames=("num_bins", "n_slots",
                                              "word_tile", "row_block",
-                                             "nterms", "radix",
+                                             "nterms", "radix", "quant",
                                              "interpret"))
 def build_histogram_segments(bins_words: jax.Array, w: jax.Array,
                              lid: jax.Array, chunk_slot: jax.Array,
@@ -415,13 +455,14 @@ def build_histogram_segments(bins_words: jax.Array, w: jax.Array,
                              *, num_bins: int, n_slots: int,
                              word_tile: int = 2, row_block: int = 2048,
                              nterms: int = 2, radix: Optional[bool] = None,
+                             quant: bool = False,
                              interpret: bool = False
                              ) -> jax.Array:
     """Per-slot histograms over lid-masked row chunks (see block comment).
 
     bins_words : (Fw, N) int32 packed codes; w (3, N) f32 with channel 2 a
                  {0,1} bag mask (see ``build_histogram_packed``); lid (N,)
-                 int32.
+                 int32.  ``quant`` as in ``build_histogram_packed``.
     chunk_*    : (T,) int32 — output slot (== n_slots ⇒ no-op), row-block
                  index, and lid value per chunk; slots non-decreasing.
     Returns (n_slots, Fw*4, num_bins, 3) f32.
@@ -430,7 +471,7 @@ def build_histogram_segments(bins_words: jax.Array, w: jax.Array,
     word_tile, rb, b_pad = _tile_params(fw, n, word_tile, row_block,
                                         num_bins)
     if radix is None:
-        radix = nterms > 0 and b_pad % 32 == 0
+        radix = (nterms > 0 or quant) and b_pad % 32 == 0
     grid = (fw // word_tile, chunk_slot.shape[0])
     if radix:
         hi_n = b_pad // 32
@@ -457,7 +498,7 @@ def build_histogram_segments(bins_words: jax.Array, w: jax.Array,
     out = pl.pallas_call(
         functools.partial(_hist_kernel_segment, num_bins_padded=b_pad,
                           word_tile=word_tile, nterms=nterms,
-                          n_slots=n_slots, radix=radix),
+                          n_slots=n_slots, radix=radix, quant=quant),
         grid_spec=grid_spec,
         out_shape=out_shape,
         compiler_params=_CompilerParams(
@@ -489,7 +530,7 @@ def build_histogram_segments(bins_words: jax.Array, w: jax.Array,
 
 def _hist_kernel_multislot(bins_ref, w_ref, slot_ref, out_ref, *,
                            num_bins_padded: int, word_tile: int, nterms: int,
-                           n_slots: int):
+                           n_slots: int, quant: bool = False):
     j = pl.program_id(1)
 
     @pl.when(j == 0)
@@ -503,9 +544,10 @@ def _hist_kernel_multislot(bins_ref, w_ref, slot_ref, out_ref, *,
     iota_s = jax.lax.broadcasted_iota(jnp.int32, (n_slots, rb), 0)
     soh = slot_blk[None, :] == iota_s                      # (K, Rb) bool
     iota_b = jax.lax.broadcasted_iota(jnp.int32, (bp, rb), 0)
-    if nterms > 0:
-        wt = _expand_terms_mixed(w_blk, nterms)        # (2T+1, Rb) bf16
-        nt = 2 * nterms + 1
+    if nterms > 0 or quant:
+        wt = _expand_terms_quant(w_blk) if quant \
+            else _expand_terms_mixed(w_blk, nterms)    # (2T+1, Rb) bf16
+        nt = wt.shape[0]
         a = (soh.astype(jnp.bfloat16)[:, None, :] * wt[None, :, :]) \
             .reshape(n_slots * nt, rb)
         for wd in range(word_tile):
@@ -516,7 +558,9 @@ def _hist_kernel_multislot(bins_ref, w_ref, slot_ref, out_ref, *,
             part = jax.lax.dot_general(
                 a, oh, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32)        # (K*nt, 4B)
-            acc = _reduce_mixed(part.reshape(n_slots, nt, 4 * bp), nterms)
+            p3 = part.reshape(n_slots, nt, 4 * bp)
+            acc = _reduce_quant(p3) if quant \
+                else _reduce_mixed(p3, nterms)
             out_ref[wd, :, :, :] += acc
     else:  # full f32 emulation (tpu_hist_precision=highest)
         a = (soh.astype(jnp.float32)[:, None, :] * w_blk[None, :, :]) \
@@ -535,17 +579,20 @@ def _hist_kernel_multislot(bins_ref, w_ref, slot_ref, out_ref, *,
 
 @functools.partial(jax.jit, static_argnames=("num_bins", "n_slots",
                                              "word_tile", "row_block",
-                                             "nterms", "interpret"))
+                                             "nterms", "quant",
+                                             "interpret"))
 def build_histogram_multislot(bins_words: jax.Array, w: jax.Array,
                               slot: jax.Array, *, num_bins: int,
                               n_slots: int, word_tile: int = 2,
                               row_block: int = 2048, nterms: int = 2,
+                              quant: bool = False,
                               interpret: bool = False) -> jax.Array:
     """Per-slot histograms over the FULL row axis in one pass.
 
     bins_words : (Fw, N) int32 packed codes; w (3, N) f32 (already masked
                  by bag); slot (N,) int32 — output slot per row, any value
-                 outside [0, n_slots) contributes nowhere.
+                 outside [0, n_slots) contributes nowhere.  ``quant`` as
+                 in ``build_histogram_packed``.
     Returns (n_slots, Fw*4, num_bins, 3) f32.
     """
     fw, n = bins_words.shape
@@ -555,7 +602,7 @@ def build_histogram_multislot(bins_words: jax.Array, w: jax.Array,
     out = pl.pallas_call(
         functools.partial(_hist_kernel_multislot, num_bins_padded=b_pad,
                           word_tile=word_tile, nterms=nterms,
-                          n_slots=n_slots),
+                          n_slots=n_slots, quant=quant),
         grid=grid,
         in_specs=[
             pl.BlockSpec((word_tile, rb), lambda i, j: (i, j)),

@@ -1,0 +1,270 @@
+"""Quantized-gradient training primitives (LightGBM recipe, Shi et al.
+NeurIPS 2022: "Quantized Training of Gradient Boosting Decision Trees").
+
+Per boosting round the f32 gradient/hessian rows are discretized onto a
+tiny integer grid — ``gq ∈ [-GMAX, GMAX]``, ``hq ∈ [1, HMAX]`` for bagged
+rows — with STOCHASTIC rounding (unbiased: E[gq·sg] = g) and a per-round
+POWER-OF-TWO scale.  The pow2 scale is the load-bearing trick:
+
+  * ``gq·sg`` / ``hq·sh`` are exact in f32 **and in bf16** (the integer
+    fits 4 bits, the scale only shifts the exponent), so the dequantized
+    lanes flow through every existing histogram kernel — including the
+    bf16-term Pallas MXU kernels — with ZERO representation error.  The
+    quantized mode therefore needs one bf16 term per lane instead of
+    ``nterms`` (see ``ops/hist_pallas.py`` quant mode), and sibling
+    histogram subtraction stays bit-exact.
+  * Histogram sums are integer multiples of the scale: two histograms
+    built over the same rows agree bitwise regardless of accumulation
+    order (up to the f32-exact window below), which is what makes the
+    sharded learners record-exact for free.
+
+Count-channel contract in quantized mode: the histogram count channel
+carries **Σhq/m̄** — integer hessian mass normalized by the per-round
+mean mass per bagged row ``m̄ = Σhq_global / Σbag_global`` — the hessian
+lane is duplicated into the count channel and rescaled by ``1/(sh·m̄)``.
+Normalizing matters for SHAPE, not just semantics: raw Σhq inflates
+"counts" ~m̄-fold (≈8× on typical binary workloads), so
+``min_data_in_leaf`` would admit ~m̄× smaller leaves and the quantized
+trees grow far deeper than the f32 trees they replace (2.3× the stall
+splits on the bench workload — slower AND overfit).  With the
+normalization ``min_data_in_leaf`` gates on effective rows (rows
+weighted by relative hessian), and under uniform hessians the channel
+equals the exact row count bitwise (every factor is a pow2 scaling).
+Both global sums are exact integers in f32 under the F32_EXACT_ROWS
+gate, so m̄ is order-independent and the sharded learners stay
+record-exact.  Exact per-leaf ROW counts still come from the wave
+learner's integer count machinery, which never reads histogram
+channels.
+
+Stochastic rounding is STATELESS: a murmur3-finalizer hash of
+``(global_row_index, bitcast(value), lane_salt)`` supplies the uniform.
+Sharded learners pass their row offset so every device quantizes its
+rows exactly as the serial learner would — record-exactness by
+construction, no RNG key threading.
+
+The packed single-pass accumulator packs both lanes into one int32 word
+``gq·2^16 + hq`` so ONE integer histogram pass accumulates both; the
+no-carry window (``Σhq < 2^16`` and ``|Σgq| < 2^15`` per bin) holds for
+any ≤ ``PACKED_SAFE_ROWS`` rows, and the chunked variant extends it to
+arbitrary N.  This is the XLA analogue of the reference OpenCL kernels'
+packed local-memory accumulation (`src/treelearner/ocl/histogram256.cl`).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+# Integer grids.  GMAX=7 / HMAX=15 (3-bit gradients, 4-bit hessians —
+# the NeurIPS-2022 paper's working range with leaf-output renewal, which
+# ``learner_wave._emit_tree_wave`` performs from the retained f32
+# gradients) keep the packed word's no-carry window at 4368 rows/bin and
+# the int16 exchange tier valid to ~2.2k global rows; stochastic
+# rounding keeps the expectation exact at any width.  The coarser
+# GMAX=3/HMAX=7 grid measurably drifts split structure on the bench
+# workload (AUC delta ~1.6e-3 vs f32 after 10 rounds); this one holds
+# the 1e-3 contract.
+GMAX = 7
+HMAX = 15
+
+# Largest per-bin row count for which the packed int32 word cannot carry
+# between lanes: Σhq ≤ HMAX·rows < 2^16 and |Σgq| ≤ GMAX·rows < 2^15.
+PACKED_SAFE_ROWS = min((1 << 16) // HMAX - 1, (1 << 15) // GMAX - 1)
+
+# f32 histogram accumulation of Σhq is exact while the running sum stays
+# below 2^24 (f32 integer window); beyond that the quantized mode's
+# bit-exactness story breaks and the gate refuses.
+F32_EXACT_ROWS = (1 << 24) // HMAX
+
+
+def pow2_ceil_scale(t: jax.Array) -> jax.Array:
+    """Smallest power of two >= t (t > 0); 1.0 when t <= 0.
+
+    frexp gives t = mant·2^e with mant ∈ [0.5, 1): 2^e >= t always, and
+    the bound is loose only when mant == 0.5 exactly (t itself a power
+    of two), where 2^(e-1) == t is the tight answer.
+    """
+    t = jnp.asarray(t, jnp.float32)
+    mant, e = jnp.frexp(t)
+    scale = jnp.where(mant == 0.5, jnp.ldexp(jnp.float32(1.0), e - 1),
+                      jnp.ldexp(jnp.float32(1.0), e))
+    return jnp.where(t > 0, scale, jnp.float32(1.0)).astype(jnp.float32)
+
+
+def _hash_uniform(idx: jax.Array, value: jax.Array, salt: int) -> jax.Array:
+    """Stateless uniform in [0, 1): murmur3 finalizer over the global row
+    index, the value's bit pattern, and a per-lane salt."""
+    bits = jax.lax.bitcast_convert_type(value.astype(jnp.float32),
+                                        jnp.uint32)
+    h = idx.astype(jnp.uint32) * jnp.uint32(0x85EBCA6B)
+    h = h ^ bits ^ jnp.uint32(salt)
+    h = h ^ (h >> 16)
+    h = h * jnp.uint32(0x85EBCA6B)
+    h = h ^ (h >> 13)
+    h = h * jnp.uint32(0xC2B2AE35)
+    h = h ^ (h >> 16)
+    return (h >> 8).astype(jnp.float32) * jnp.float32(2.0 ** -24)
+
+
+def stochastic_round(x: jax.Array, idx: jax.Array, salt: int) -> jax.Array:
+    """Unbiased rounding: floor(x) + Bernoulli(frac(x)), the Bernoulli
+    driven by the stateless hash so it is a pure function of
+    (row index, value, lane)."""
+    f = jnp.floor(x)
+    u = _hash_uniform(idx, x, salt)
+    return f + (u < (x - f)).astype(jnp.float32)
+
+
+_G_SALT = 0x51ED2701
+_H_SALT = 0x3C6EF372
+
+
+def quantize_gradients(gb: jax.Array, hb: jax.Array, bag: jax.Array,
+                       row_offset: jax.Array, max_abs_g: jax.Array,
+                       max_abs_h: jax.Array
+                       ) -> Tuple[jax.Array, jax.Array, jax.Array,
+                                  jax.Array]:
+    """Discretize bagged gradient/hessian rows onto the integer grid.
+
+    gb, hb : (N,) f32 — grad·bag, hess·bag (already bag-masked).
+    bag    : (N,) f32 {0,1} bag mask.
+    row_offset : int32 scalar — this shard's global row offset (serial: 0).
+    max_abs_g, max_abs_h : f32 scalars — GLOBAL maxima of |gb| / hb (the
+        sharded learners pmax these before calling).
+
+    Returns (gd, hd, sg, sh): DEQUANTIZED lanes gd = gq·sg, hd = hq·sh
+    (exact products — pow2 scale) and the two scales.  Both lanes round
+    UNBIASEDLY — hq ∈ [0, HMAX] may round a small hessian to zero (a
+    floor of one quantum was tried first and inflates confident rows'
+    hessians ~sh/h-fold, drifting split structure past the 1e-3 AUC
+    contract); unbagged rows are exact zeros in both lanes.
+    """
+    sg = pow2_ceil_scale(max_abs_g / GMAX)
+    sh = pow2_ceil_scale(max_abs_h / HMAX)
+    idx = row_offset.astype(jnp.int32) + jnp.arange(gb.shape[0],
+                                                    dtype=jnp.int32)
+    gq = stochastic_round(gb / sg, idx, _G_SALT)
+    gq = jnp.clip(gq, -float(GMAX), float(GMAX))
+    hq = stochastic_round(hb / sh, idx, _H_SALT)
+    hq = jnp.clip(hq, 0.0, float(HMAX))
+    bagf = bag.astype(jnp.float32)
+    return gq * sg * bagf, hq * sh * bagf, sg, sh
+
+
+# ---------------------------------------------------------------------------
+# Packed int32 single-pass accumulation.
+# ---------------------------------------------------------------------------
+
+
+def pack_gh(gq: jax.Array, hq: jax.Array) -> jax.Array:
+    """(gq << 16) | hq as carry-free int32 arithmetic: gq·2^16 + hq.
+    gq int32 in [-GMAX, GMAX], hq int32 in [0, HMAX]."""
+    return gq.astype(jnp.int32) * jnp.int32(1 << 16) + hq.astype(jnp.int32)
+
+
+def unpack_gh(word: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Decode a SUM of packed words: hq = word & 0xFFFF (the low half
+    never borrows while Σhq < 2^16), gq = (word − hq) >> 16 (exact
+    arithmetic shift — word − hq is a multiple of 2^16)."""
+    word = word.astype(jnp.int32)
+    hq = word & jnp.int32(0xFFFF)
+    gq = (word - hq) >> 16
+    return gq, hq
+
+
+def hist_accumulate_packed(bins: jax.Array, packed: jax.Array, *,
+                           num_bins: int) -> jax.Array:
+    """ONE integer histogram pass over both lanes: out[f, b] = Σ_r
+    [bins[f, r] == b] · packed[r], int32 scatter-add.
+
+    Exact only within the no-carry window (≤ PACKED_SAFE_ROWS rows per
+    bin); use ``hist_accumulate_packed_chunked`` beyond.  bins (F, N)
+    integer codes, packed (N,) int32.  Returns (F, num_bins) int32.
+    """
+    f, n = bins.shape
+    flat = (jnp.arange(f, dtype=jnp.int32)[:, None] * num_bins
+            + bins.astype(jnp.int32)).reshape(-1)
+    vals = jnp.broadcast_to(packed.astype(jnp.int32), (f, n)).reshape(-1)
+    out = jnp.zeros((f * num_bins,), jnp.int32).at[flat].add(vals)
+    return out.reshape(f, num_bins)
+
+
+def hist_accumulate_packed_chunked(bins: jax.Array, gq: jax.Array,
+                                   hq: jax.Array, *, num_bins: int,
+                                   chunk: int = 4096
+                                   ) -> Tuple[jax.Array, jax.Array]:
+    """Any-N exact packed accumulation: pack → single-pass accumulate →
+    unpack per ≤ PACKED_SAFE_ROWS chunk, summing the decoded int32 lanes
+    across chunks.  Returns ((F, num_bins) Σgq, (F, num_bins) Σhq)."""
+    assert chunk <= PACKED_SAFE_ROWS, chunk
+    f, n = bins.shape
+    pad = (-n) % chunk
+    if pad:
+        bins = jnp.pad(bins, ((0, 0), (0, pad)))
+        gq = jnp.pad(gq, (0, pad))
+        hq = jnp.pad(hq, (0, pad))
+    nc = (n + pad) // chunk
+    bins_c = bins.reshape(f, nc, chunk).transpose(1, 0, 2)
+    packed_c = pack_gh(gq, hq).reshape(nc, chunk)
+
+    def body(carry, xs):
+        b, p = xs
+        g, h = unpack_gh(hist_accumulate_packed(b, p, num_bins=num_bins))
+        return (carry[0] + g, carry[1] + h), None
+
+    init = (jnp.zeros((f, num_bins), jnp.int32),
+            jnp.zeros((f, num_bins), jnp.int32))
+    (sum_g, sum_h), _ = jax.lax.scan(body, init, (bins_c, packed_c))
+    return sum_g, sum_h
+
+
+# ---------------------------------------------------------------------------
+# int16 histogram-exchange tier for the sharded learners.
+# ---------------------------------------------------------------------------
+
+
+def exchange_tier(n_global: int) -> str:
+    """'int16' when every reduced channel provably fits int16 —
+    Σhq ≤ HMAX·N stays the binding bound (|Σgq| ≤ GMAX·N is looser) —
+    else 'f32' passthrough.  Static: resolved at trace time from the
+    global row count."""
+    return "int16" if HMAX * n_global <= 32767 else "f32"
+
+
+def pack_hist_int16(hist: jax.Array, inv_sg: jax.Array,
+                    inv_sh: jax.Array,
+                    cnt_to_int: jax.Array = 1.0) -> jax.Array:
+    """(…, 3) quantized-unit histogram → (…, 3) int16 for the wire.
+    Channels are exact integer multiples of (sg, sh, 1/cnt_to_int);
+    dividing by the scales recovers the integers exactly, rint absorbs
+    f32 dust.  ``cnt_to_int`` is the wave learners' mean-mass-per-row
+    (m̄): their count channel carries Σhq/m̄, so multiplying by m̄
+    restores the Σhq integer for the wire."""
+    mul = jnp.stack([inv_sg, inv_sh, jnp.float32(cnt_to_int)])
+    return jnp.rint(hist * mul).astype(jnp.int16)
+
+
+def unpack_hist_int16(h16: jax.Array, sg: jax.Array, sh: jax.Array,
+                      int_to_cnt: jax.Array = 1.0) -> jax.Array:
+    """Inverse of ``pack_hist_int16`` after the integer reduction.
+    ``int_to_cnt`` must be the f32 reciprocal 1/m̄ the serial count
+    rescale uses so the reconstructed channel is BITWISE the serial
+    value (both sides round the same real product Σhq·fl(1/m̄))."""
+    mul = jnp.stack([sg, sh, jnp.float32(int_to_cnt)])
+    return h16.astype(jnp.float32) * mul
+
+
+def quant_ineligible_reason(n_pad: int, hist_dp: bool) -> Optional[str]:
+    """Why quantized-gradient training cannot run, or None if it can.
+    Mirrors ``scan_ineligible_reason``: the auto mode silently falls
+    back, the explicit 'on' mode surfaces the string in the error."""
+    if hist_dp:
+        return ("hist_dp adds calibrated f32 noise to histogram bins; "
+                "quantized integer-unit histograms would denoise it")
+    if n_pad >= F32_EXACT_ROWS:
+        return (f"padded rows {n_pad} >= {F32_EXACT_ROWS}: Σhq can "
+                "leave the f32-exact integer window during histogram "
+                "accumulation")
+    return None

@@ -11,6 +11,17 @@ validity masks, and reduces the per-feature best (gain, threshold,
 direction, child aggregates) — replacing the XLA scan+argmax chain whose
 ~15 intermediate (K·F·B) arrays round-trip HBM between fused ops.
 
+``fused_child_scans`` goes one launch further for the quantized wave
+step: it takes each wave member's SMALLER-child histogram plus the
+parent's pooled histogram and performs sibling subtraction, left/right
+selection, the per-child ``FixHistogram`` default-bin rebuild, and BOTH
+children's split scans inside the same kernel — the hist→subtract→fix→
+scan chain that previously spanned one Pallas launch plus ~10 fused XLA
+ops with (2K·F·B) HBM round-trips between them.  The raw (unfixed)
+child histograms are emitted as secondary outputs so the histogram pool
+keeps the same contents as the unfused path (the fix is scan-local,
+exactly as in ``_cand_rows_batch``).
+
 Semantics are ``find_best_splits``'s exactly (missing-left/right scan
 exclusions, L1/L2/max_delta_step gain math, min_data/min_hessian
 feasibility, the largest-threshold tie-break missing-left and smallest
@@ -45,20 +56,18 @@ _CompilerParams = getattr(pltpu, "CompilerParams", None) \
 N_OUT = 8
 
 
-def _scan_kernel(hist_ref, tot_ref, nb_ref, mt_ref, db_ref,
-                 out_ref, *, b: int, f: int, lambda_l1: float,
-                 lambda_l2: float, max_delta_step: float,
-                 min_data_in_leaf: int, min_sum_hessian_in_leaf: float,
-                 min_gain_to_split: float):
+def _scan_body(hg, hh, hc, total_g, total_h, total_n, nb_a, mt_a, db_a, *,
+               b: int, f: int, lambda_l1: float, lambda_l2: float,
+               max_delta_step: float, min_data_in_leaf: int,
+               min_sum_hessian_in_leaf: float, min_gain_to_split: float):
+    """One leaf's (F, B) split scan — shared by the batched scan kernel
+    and the fused child-scan kernel.  ``total_h`` arrives with the
+    2·K_EPSILON carry already added; hg/hh/hc are (F, B) channel planes.
+    Returns the (N_OUT, F) output-plane stack."""
     l1, l2, mds = lambda_l1, lambda_l2, max_delta_step
-    h = hist_ref[0]                              # (F, 3, B)
-    hg, hh, hc = h[:, 0, :], h[:, 1, :], h[:, 2, :]
-    total_g = tot_ref[0, 0]
-    total_h = tot_ref[0, 1] + 2.0 * K_EPSILON
-    total_n = tot_ref[0, 2]
-    nb = nb_ref[...][:, None]                    # (F, 1)
-    mtype = mt_ref[...][:, None]
-    d_bin = db_ref[...][:, None]
+    nb = nb_a[:, None]                           # (F, 1)
+    mtype = mt_a[:, None]
+    d_bin = db_a[:, None]
     iota_b = lax.broadcasted_iota(jnp.int32, (f, b), 1)
     two = (nb > 2) & (mtype != MISSING_NONE)
     is_zero = mtype == MISSING_ZERO
@@ -137,7 +146,7 @@ def _scan_kernel(hist_ref, tot_ref, nb_ref, mt_ref, db_ref,
     best_g = jnp.where(use_p1, best_g_p1, best_g_m1)
     two1 = two[:, 0]
     dleft = jnp.where(use_p1, False,
-                      ~((~two1) & (mt_ref[...] == MISSING_NAN)))
+                      ~((~two1) & (mt_a == MISSING_NAN)))
 
     def take(a_m1, a_p1):
         sel = iota_b == best_t[:, None]
@@ -149,9 +158,25 @@ def _scan_kernel(hist_ref, tot_ref, nb_ref, mt_ref, db_ref,
     lc_b = take(lc_m1, lc_p1)
     lo_b = take(lo_m1, lo_p1)
     ro_b = take(ro_m1, ro_p1)
-    out_ref[0, :, :] = jnp.stack([
+    return jnp.stack([
         best_g, best_t.astype(jnp.float32), dleft.astype(jnp.float32),
         lg_b, lh_b, lc_b, lo_b, ro_b])
+
+
+def _scan_kernel(hist_ref, tot_ref, nb_ref, mt_ref, db_ref,
+                 out_ref, *, b: int, f: int, lambda_l1: float,
+                 lambda_l2: float, max_delta_step: float,
+                 min_data_in_leaf: int, min_sum_hessian_in_leaf: float,
+                 min_gain_to_split: float):
+    h = hist_ref[0]                              # (F, 3, B)
+    out_ref[0, :, :] = _scan_body(
+        h[:, 0, :], h[:, 1, :], h[:, 2, :],
+        tot_ref[0, 0], tot_ref[0, 1] + 2.0 * K_EPSILON, tot_ref[0, 2],
+        nb_ref[...], mt_ref[...], db_ref[...], b=b, f=f,
+        lambda_l1=lambda_l1, lambda_l2=lambda_l2,
+        max_delta_step=max_delta_step, min_data_in_leaf=min_data_in_leaf,
+        min_sum_hessian_in_leaf=min_sum_hessian_in_leaf,
+        min_gain_to_split=min_gain_to_split)
 
 
 @functools.partial(jax.jit, static_argnames=(
@@ -223,6 +248,139 @@ def find_best_splits_batched(hist, sum_gradients, sum_hessians, num_data,
         left_output=lo_b, right_output=ro_b)
 
 
+def _fused_kernel(hsm_ref, hpar_ref, lsm_ref, tot_ref, nb_ref, mt_ref,
+                  db_ref, hl_ref, hr_ref, out_ref, *, b: int, f: int,
+                  lambda_l1: float, lambda_l2: float,
+                  max_delta_step: float, min_data_in_leaf: int,
+                  min_sum_hessian_in_leaf: float,
+                  min_gain_to_split: float):
+    """One wave member's full child-scan chain: sibling subtraction,
+    left/right selection, per-child FixHistogram, both split scans."""
+    h_small = hsm_ref[0]                         # (F, 3, B)
+    h_par = hpar_ref[0]
+    h_large = h_par - h_small
+    lsm = lsm_ref[0, 0] > 0
+    hl = jnp.where(lsm, h_small, h_large)
+    hr = jnp.where(lsm, h_large, h_small)
+    # RAW (unfixed) child histograms back to the pool — identical pool
+    # contents to the unfused path; the default-bin fix is scan-local
+    hl_ref[0] = hl
+    hr_ref[0] = hr
+    db = db_ref[...]
+    iota_b = lax.broadcasted_iota(jnp.int32, (f, b), 1)
+    dbm = (iota_b == db[:, None]) & (db[:, None] > 0)      # (F, B)
+    keep = (~dbm).astype(jnp.float32)
+    for c, hch in ((0, hl), (1, hr)):
+        tg = tot_ref[0, c, 0]
+        th_raw = tot_ref[0, c, 1]
+        tn = tot_ref[0, c, 2]
+        # Dataset::FixHistogram (`src/io/dataset.cpp:923-941`): rebuild
+        # the default-bin entry as child totals minus the other bins
+        others_g = jnp.sum(hch[:, 0, :] * keep, axis=1)    # (F,)
+        others_h = jnp.sum(hch[:, 1, :] * keep, axis=1)
+        others_c = jnp.sum(hch[:, 2, :] * keep, axis=1)
+        hg = jnp.where(dbm, (tg - others_g)[:, None], hch[:, 0, :])
+        hh = jnp.where(dbm, (th_raw - others_h)[:, None], hch[:, 1, :])
+        hc = jnp.where(dbm, (tn - others_c)[:, None], hch[:, 2, :])
+        out_ref[0, c, :, :] = _scan_body(
+            hg, hh, hc, tg, th_raw + 2.0 * K_EPSILON, tn,
+            nb_ref[...], mt_ref[...], db, b=b, f=f,
+            lambda_l1=lambda_l1, lambda_l2=lambda_l2,
+            max_delta_step=max_delta_step,
+            min_data_in_leaf=min_data_in_leaf,
+            min_sum_hessian_in_leaf=min_sum_hessian_in_leaf,
+            min_gain_to_split=min_gain_to_split)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "lambda_l1", "lambda_l2", "max_delta_step", "min_data_in_leaf",
+    "min_sum_hessian_in_leaf", "min_gain_to_split", "interpret"))
+def fused_child_scans(h_small, h_par, left_small, sum_g2, sum_h2, num2,
+                      num_bin, missing_type, default_bin, feature_mask, *,
+                      lambda_l1: float = 0.0, lambda_l2: float = 0.0,
+                      max_delta_step: float = 0.0,
+                      min_data_in_leaf: int = 20,
+                      min_sum_hessian_in_leaf: float = 1e-3,
+                      min_gain_to_split: float = 0.0,
+                      interpret: bool = False):
+    """Fused subtract→select→fix→scan for all K wave members.
+
+    h_small    : (K, F, B, 3) f32 — each member's SMALLER-child histogram.
+    h_par      : (K, F, B, 3) f32 — the member's pooled parent histogram.
+    left_small : (K,) bool — whether the smaller child is the left child.
+    sum_g2/sum_h2/num2 : (2K,) f32 — per-child totals, interleaved
+                 [l0, r0, l1, r1, …] exactly as ``_children_bookkeeping``
+                 builds them.
+    Returns (cands, hl, hr): a (2K, F)-batched SplitCandidates in the
+    same interleaved child order, plus the RAW left/right child
+    histograms (K, F, B, 3) for the caller's pool writes.
+    """
+    k, f, b, _ = h_small.shape
+    hs_t = h_small.transpose(0, 1, 3, 2)          # (K, F, 3, B)
+    hp_t = h_par.transpose(0, 1, 3, 2)
+    lsm = left_small.astype(jnp.int32)[:, None]   # (K, 1)
+    totals = jnp.stack([sum_g2.reshape(k, 2), sum_h2.reshape(k, 2),
+                        num2.reshape(k, 2)], axis=2) \
+        .astype(jnp.float32)                      # (K, 2, 3)
+    kern = functools.partial(
+        _fused_kernel, b=b, f=f, lambda_l1=lambda_l1, lambda_l2=lambda_l2,
+        max_delta_step=max_delta_step, min_data_in_leaf=min_data_in_leaf,
+        min_sum_hessian_in_leaf=min_sum_hessian_in_leaf,
+        min_gain_to_split=min_gain_to_split)
+    hl_t, hr_t, out = pl.pallas_call(
+        kern,
+        grid=(k,),
+        in_specs=[
+            pl.BlockSpec((1, f, 3, b), lambda i: (i, 0, 0, 0)),
+            pl.BlockSpec((1, f, 3, b), lambda i: (i, 0, 0, 0)),
+            pl.BlockSpec((1, 1), lambda i: (i, 0)),
+            pl.BlockSpec((1, 2, 3), lambda i: (i, 0, 0)),
+            pl.BlockSpec((f,), lambda i: (0,)),
+            pl.BlockSpec((f,), lambda i: (0,)),
+            pl.BlockSpec((f,), lambda i: (0,)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, f, 3, b), lambda i: (i, 0, 0, 0)),
+            pl.BlockSpec((1, f, 3, b), lambda i: (i, 0, 0, 0)),
+            pl.BlockSpec((1, 2, N_OUT, f), lambda i: (i, 0, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((k, f, 3, b), jnp.float32),
+            jax.ShapeDtypeStruct((k, f, 3, b), jnp.float32),
+            jax.ShapeDtypeStruct((k, 2, N_OUT, f), jnp.float32),
+        ],
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel",)),
+        interpret=interpret,
+    )(hs_t, hp_t, lsm, totals, num_bin.astype(jnp.int32),
+      missing_type.astype(jnp.int32), default_bin.astype(jnp.int32))
+    out = out.reshape(2 * k, N_OUT, f)
+    total_g = sum_g2.astype(jnp.float32)
+    total_h = sum_h2.astype(jnp.float32) + 2.0 * K_EPSILON
+    total_n = num2.astype(jnp.float32)
+    best_g = out[:, 0, :]
+    best_t = jnp.rint(out[:, 1, :]).astype(jnp.int32)
+    dleft = out[:, 2, :] > 0.5
+    lg_b, lh_b, lc_b = out[:, 3, :], out[:, 4, :], out[:, 5, :]
+    lo_b, ro_b = out[:, 6, :], out[:, 7, :]
+    gain_shift = leaf_split_gain(total_g, total_h, lambda_l1, lambda_l2,
+                                 max_delta_step)
+    min_gain_shift = (gain_shift + min_gain_to_split)[:, None]
+    invalid = jnp.isneginf(best_g) | ~feature_mask[None, :]
+    tg, th, tn = total_g[:, None], total_h[:, None], total_n[:, None]
+    cands = SplitCandidates(
+        gain=jnp.where(invalid, K_MIN_SCORE, best_g - min_gain_shift),
+        threshold=best_t,
+        default_left=dleft,
+        left_sum_g=lg_b, left_sum_h=lh_b - K_EPSILON, left_cnt=lc_b,
+        right_sum_g=tg - lg_b, right_sum_h=th - lh_b - K_EPSILON,
+        right_cnt=tn - lc_b,
+        left_output=lo_b, right_output=ro_b)
+    hl = hl_t.transpose(0, 1, 3, 2)
+    hr = hr_t.transpose(0, 1, 3, 2)
+    return cands, hl, hr
+
+
 def scan_ineligible_reason(f: int, b: int, has_monotone: bool,
                            has_categorical: bool, has_penalty: bool,
                            hist_dp: bool):
@@ -239,4 +397,14 @@ def scan_ineligible_reason(f: int, b: int, has_monotone: bool,
         return f"{b} bins > 512 (triangular scan block)"
     if f * b * 12 > (1 << 22):
         return "histogram block exceeds the 4MB VMEM budget"
+    return None
+
+
+def fused_scan_ineligible_reason(f: int, b: int):
+    """Extra VMEM gate for ``fused_child_scans`` on top of
+    ``scan_ineligible_reason``: the fused kernel holds four (F, 3, B)
+    histogram blocks (small, parent, left, right) plus the scan
+    transients at once."""
+    if f * b * 12 * 6 > (1 << 22):
+        return "fused child-scan blocks exceed the 4MB VMEM budget"
     return None

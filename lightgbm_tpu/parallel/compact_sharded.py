@@ -178,18 +178,14 @@ class ShardedCompactLearner(CompactTPUTreeLearner):
         """Histogram exchange: reduce-scatter over the feature axis so each
         device sums (and later scans) a feature slice
         (`data_parallel_tree_learner.cpp:146-161`)."""
-        self._rec_coll("psum_scatter", local_hist)
-        return lax.psum_scatter(local_hist, self.axis, scatter_dimension=0,
-                                tiled=True)
+        return self._exchange(local_hist, 0)
 
     def _reduce_hist_batch(self, local_hists):
         """Batched (K, F, B, 3) member histograms exchanged in ONE
         collective (scatter over the feature axis), mirroring the wave
         body's single psum_scatter per wave — K per-member exchanges
         would pay K collective latencies per stall event."""
-        self._rec_coll("psum_scatter", local_hists)
-        return lax.psum_scatter(local_hists, self.axis,
-                                scatter_dimension=1, tiled=True)
+        return self._exchange(local_hists, 1)
 
     def _sync_counts(self, lc_bag, c_bag):
         """Global bagged counts from the local partition's sums."""
@@ -200,6 +196,45 @@ class ShardedCompactLearner(CompactTPUTreeLearner):
     def _global_scalar(self, v):
         self._rec_coll("psum", v)
         return lax.psum(v, self.axis)
+
+    def _global_max(self, v):
+        self._rec_coll("pmax", v)
+        return lax.pmax(v, self.axis)
+
+    def _global_row_offset(self):
+        # rows are shard-contiguous in axis order, so shard d quantizes
+        # rows [d·n_local, (d+1)·n_local) exactly as the serial learner
+        # would (the stochastic-rounding hash keys on the global index)
+        return lax.axis_index(self.axis) * jnp.int32(self.n_local)
+
+    # -- int16 histogram wire format (quantized mode, ops/quant.py) ----------
+
+    def _wire_int16(self) -> bool:
+        """Quantized histograms ride the exchange as int16 integer units
+        when every reduced channel provably fits (GLOBAL row bound)."""
+        from ..ops.quant import exchange_tier
+        return bool(getattr(self, "_quant", False)) \
+            and exchange_tier(self.n_pad) == "int16"
+
+    def _exchange(self, h, dim: int):
+        """One histogram reduce-scatter over the data axis.  In quantized
+        mode with the int16 tier active, channels are divided back to
+        integer units and shipped as int16 — HALF the f32 payload — then
+        rescaled after the integer reduction (exact: sums are bounded by
+        the tier gate).  The ledger records the PACKED operand so traced
+        collective payload bytes reflect the wire format."""
+        if self._wire_int16():
+            from ..ops.quant import pack_hist_int16, unpack_hist_int16
+            inv_sg, inv_sh = self._q_inv
+            h16 = pack_hist_int16(h, inv_sg, inv_sh, self._q_mbar)
+            self._rec_coll("psum_scatter", h16)
+            h16 = lax.psum_scatter(h16, self.axis, scatter_dimension=dim,
+                                   tiled=True)
+            return unpack_hist_int16(h16, *self._q_scales,
+                                     1.0 / self._q_mbar)
+        self._rec_coll("psum_scatter", h)
+        return lax.psum_scatter(h, self.axis, scatter_dimension=dim,
+                                tiled=True)
 
     def _child_best_rows(self, hist_left, hist_right, crow_f, fmask_pad,
                          depth_ok, constraints):
@@ -434,6 +469,16 @@ class ShardedCompactLearner(CompactTPUTreeLearner):
             m = (pos >= off) & (pos < off + cnt) & (lid == leaf)
             wm = ww * m[None, :].astype(ww.dtype)
             bu = unpack_bin_words(bw, fw * 4)     # keep padded features
+            if self._quant:
+                # quantized lanes (mirrors the serial branch): two
+                # channels ride the contraction, the count channel is the
+                # normalized Σhq/m̄ effective row count — identical
+                # channels to the serial quant learner keep the records
+                # stream exact
+                h2 = build_histogram_onehot(bu, wm[:2], num_bins=b)
+                h = jnp.concatenate([h2, h2[:, :, 1:2]], axis=2)
+                return h * jnp.stack([jnp.float32(1.0), jnp.float32(1.0),
+                                      self._q_cnt])
             return build_histogram_onehot(bu, wm, num_bins=b,
                                           dp=self.hist_dp)
 
@@ -554,10 +599,7 @@ class ShardedVotingLearner(ShardedCompactLearner):
                 - jnp.arange(self.f_pad, dtype=jnp.float32)
             sel = jnp.sort(lax.top_k(score, self.k2)[1]).astype(jnp.int32)
             # ---- CopyLocalHistogram: exchange only elected features
-            sel_hist = hist[sel]                              # (k2, B, 3)
-            self._rec_coll("psum_scatter", sel_hist)
-            sel_hist = lax.psum_scatter(sel_hist, self.axis,
-                                        scatter_dimension=0, tiled=True)
+            sel_hist = self._exchange(hist[sel], 0)           # (k2s, B, 3)
             my_sel = lax.dynamic_slice_in_dim(sel, d * self.k2s, self.k2s)
             gidx = lambda a: a[my_sel]
             g, thr, dl, ic, bits, lsg2, lsh2, lcn2, rsg, rsh, rcn, lo, ro = \

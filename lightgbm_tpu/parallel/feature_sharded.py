@@ -83,6 +83,12 @@ class FeatureShardedCompactLearner(ShardedCompactLearner):
     def _global_scalar(self, v):
         return v                            # rows are replicated
 
+    def _global_max(self, v):
+        return v                            # rows are replicated
+
+    def _global_row_offset(self):
+        return jnp.int32(0)                 # every device holds all rows
+
     def _reduce_hist(self, local_hist):
         return local_hist                   # hist IS the local slice
 
@@ -109,6 +115,13 @@ class FeatureShardedCompactLearner(ShardedCompactLearner):
             m = (pos >= off) & (pos < off + cnt) & (lid == leaf)
             wm = ww * m[None, :].astype(ww.dtype)
             bu = unpack_bin_words(bw, fws * 4)
+            if self._quant:
+                # quantized lanes over the feature slice (no exchange —
+                # same channel contract as the serial quant branch)
+                h2 = build_histogram_onehot(bu, wm[:2], num_bins=b)
+                h = jnp.concatenate([h2, h2[:, :, 1:2]], axis=2)
+                return h * jnp.stack([jnp.float32(1.0), jnp.float32(1.0),
+                                      self._q_cnt])
             return build_histogram_onehot(bu, wm, num_bins=b,
                                           dp=self.hist_dp)
 
@@ -216,13 +229,15 @@ class FeatureShardedWaveLearner(FeatureShardedCompactLearner,
             except TypeError:
                 fn = shard_map(self._train_tree_feature_wave,
                                check_rep=False, **kw)
-            self._jit_tree_w = jax.jit(fn)
+            self._jit_tree_w = jax.jit(fn, donate_argnums=(1, 2)) \
+                if self._donate else jax.jit(fn)
         return self._pop_telem(self._jit_tree_w(
             self.sharded_bins(), grad, hess, bag, fmask_pad))
 
     def lowered_hlo_text(self) -> str:
         z = jnp.zeros(self.n_pad, jnp.float32)
         self.train_async(z, z, z)
+        z = jnp.zeros(self.n_pad, jnp.float32)  # donation may consume z
         fmask_pad = jnp.ones(self.f_pad, bool)
         return self._jit_tree_w.lower(
             self.sharded_bins(), z, z, z, fmask_pad).compile().as_text()

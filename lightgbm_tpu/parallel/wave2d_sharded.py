@@ -242,9 +242,7 @@ class ShardedWave2DLearner(ShardedWaveLearner):
             _, h_loc = lax.scan(hist_member, 0,
                                 (sm_slot[lo:hi], sm_start[lo:hi],
                                  sm_cnt[lo:hi], valid[lo:hi]))
-            self._rec_coll("psum_scatter", h_loc)
-            parts.append(lax.psum_scatter(h_loc, self.axis,
-                                          scatter_dimension=1, tiled=True))
+            parts.append(self._exchange(h_loc, 1))
         h_small = parts[0] if len(parts) == 1 else \
             jnp.concatenate(parts, axis=0)      # (W, fs, B, 3)
         h_par = st.hist_pool[ph]
@@ -277,7 +275,8 @@ class ShardedWave2DLearner(ShardedWaveLearner):
             except TypeError:
                 fn = shard_map(self._train_tree_wave_sharded,
                                check_rep=False, **kw)
-            self._jit_tree_w = jax.jit(fn)
+            self._jit_tree_w = jax.jit(fn, donate_argnums=(1, 2)) \
+                if self._donate else jax.jit(fn)
         return self._pop_telem(self._jit_tree_w(
             self.sharded_bins(), grad, hess, bag, fmask_pad))
 

@@ -115,10 +115,9 @@ class ShardedWaveLearner(ShardedCompactLearner, WaveTPUTreeLearner):
 
         _, h_local = lax.scan(hist_member, 0,
                               (sm_slot, sm_start, sm_cnt, valid))
-        # (W, f_pad, B, 3) -> (W, fs, B, 3): one collective per wave
-        self._rec_coll("psum_scatter", h_local)
-        h_small = lax.psum_scatter(h_local, self.axis, scatter_dimension=1,
-                                   tiled=True)
+        # (W, f_pad, B, 3) -> (W, fs, B, 3): one collective per wave,
+        # int16-packed in quantized mode (_exchange)
+        h_small = self._exchange(h_local, 1)
         h_par = st.hist_pool[ph]                       # (W, fs, B, 3)
         h_large = h_par - h_small
         lsm = left_small[:, None, None, None]
@@ -175,7 +174,8 @@ class ShardedWaveLearner(ShardedCompactLearner, WaveTPUTreeLearner):
             except TypeError:
                 fn = shard_map(self._train_tree_wave_sharded,
                                check_rep=False, **kw)
-            self._jit_tree_w = jax.jit(fn)
+            self._jit_tree_w = jax.jit(fn, donate_argnums=(1, 2)) \
+                if self._donate else jax.jit(fn)
         return self._pop_telem(self._jit_tree_w(
             self.sharded_bins(), grad, hess, bag, fmask_pad))
 
@@ -183,6 +183,7 @@ class ShardedWaveLearner(ShardedCompactLearner, WaveTPUTreeLearner):
         n = self.n_pad
         z = jnp.zeros(n, jnp.float32)
         self.train_async(z, z, z)  # build the jit
+        z = jnp.zeros(n, jnp.float32)   # donation may consume the first z
         fmask_pad = jnp.ones(self.f_pad, bool)
         return self._jit_tree_w.lower(
             self.sharded_bins(), z, z, z, fmask_pad).compile().as_text()

@@ -1,0 +1,402 @@
+"""Quantized-gradient training (`ops/quant.py`, round 8).
+
+The LightGBM quantized-training recipe (Shi et al., NeurIPS 2022):
+per-round stochastic discretization of grad/hess onto a tiny integer grid
+with a power-of-two scale, packed int32 single-pass histogram
+accumulation, leaf outputs renewed from the retained f32 gradients, and
+an int16 wire tier for the sharded histogram exchange.  Contracts under
+test, straight from the acceptance bar:
+
+  * packed accumulation is COUNT-EXACT against a numpy reference, both
+    inside the no-carry window and chunked beyond it;
+  * quantized training holds AUC within 1e-3 of f32 and reproduces the
+    f32 split structure exactly on a dyadic fixture whose round-1
+    quantization is lossless;
+  * every sharded mode (1-D and the 2x2 / 2x4 hybrid meshes) is
+    record-exact against the SERIAL quantized learner with the int16
+    exchange tier engaged, and its pinned wire payload is at most half
+    the f32 program's;
+  * the fused Pallas child-scan chain launches strictly fewer kernels
+    than the unfused step and produces bit-identical models;
+  * buffer donation (`tpu_donate_buffers`) changes nothing numerically.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.learner_wave import WaveTPUTreeLearner
+from lightgbm_tpu.ops import quant as Q
+
+
+def _booster(X, y, rounds, **extra):
+    params = {"objective": "binary", "num_leaves": 15, "min_data_in_leaf": 5,
+              "verbosity": -1}
+    params.update(extra)
+    ds = lgb.Dataset(X, label=y, params=params)
+    bst = lgb.Booster(params, ds)
+    for _ in range(rounds):
+        bst.update()
+    return bst
+
+
+def _auc(y, s):
+    order = np.argsort(s, kind="stable")
+    r = np.empty(len(s))
+    r[order] = np.arange(1, len(s) + 1)
+    npos = int((y == 1).sum())
+    nneg = len(y) - npos
+    return (r[y == 1].sum() - npos * (npos + 1) / 2) / (npos * nneg)
+
+
+# -- quantization primitives -------------------------------------------------
+
+def test_pow2_ceil_scale():
+    for t in (1e-6, 0.07, 0.3, 0.5, 1.0, 3.7, 1000.0):
+        s = float(Q.pow2_ceil_scale(jnp.float32(t)))
+        assert s >= t
+        assert float(np.log2(s)) == int(np.log2(s)), (t, s)
+        assert s / 2 < t, "not the SMALLEST covering pow2"
+    # exact powers of two map to themselves, degenerate inputs to 1.0
+    assert float(Q.pow2_ceil_scale(jnp.float32(0.25))) == 0.25
+    assert float(Q.pow2_ceil_scale(jnp.float32(0.0))) == 1.0
+    assert float(Q.pow2_ceil_scale(jnp.float32(-3.0))) == 1.0
+
+
+def test_stochastic_round_unbiased_and_stateless():
+    n = 1 << 15
+    x = jnp.full((n,), 0.25, jnp.float32)
+    idx = jnp.arange(n, dtype=jnp.int32)
+    r = np.asarray(Q.stochastic_round(x, idx, Q._G_SALT))
+    assert set(np.unique(r)) <= {0.0, 1.0}
+    assert abs(r.mean() - 0.25) < 0.02, "E[round(x)] must equal x"
+    # stateless: a pure function of (index, value, salt)
+    np.testing.assert_array_equal(
+        r, np.asarray(Q.stochastic_round(x, idx, Q._G_SALT)))
+    r2 = np.asarray(Q.stochastic_round(x, idx, Q._H_SALT))
+    assert (r != r2).any(), "lane salts must decorrelate the lanes"
+    # shifting the row offset re-keys every row (the sharded learners
+    # pass global offsets so shard-local calls reproduce the serial ones)
+    r3 = np.asarray(Q.stochastic_round(x, idx + 7, Q._G_SALT))
+    assert (r != r3).any()
+    np.testing.assert_array_equal(
+        r[7:], np.asarray(Q.stochastic_round(x, idx, Q._G_SALT))[7:])
+
+
+def test_quantize_gradients_grid_and_exactness(rng):
+    n = 1024
+    g = jnp.asarray(rng.randn(n).astype(np.float32))
+    h = jnp.asarray(np.abs(rng.randn(n)).astype(np.float32) + 0.01)
+    bag = jnp.ones(n, jnp.float32).at[::5].set(0.0)
+    gb, hb = g * bag, h * bag
+    gd, hd, sg, sh = Q.quantize_gradients(
+        gb, hb, bag, jnp.int32(0), jnp.max(jnp.abs(gb)), jnp.max(hb))
+    gq = np.asarray(gd / sg)
+    hq = np.asarray(hd / sh)
+    # pow2 scale => the dequantized lanes are EXACT integer multiples
+    np.testing.assert_array_equal(gq, np.rint(gq))
+    np.testing.assert_array_equal(hq, np.rint(hq))
+    assert np.abs(gq).max() <= Q.GMAX and hq.min() >= 0 \
+        and hq.max() <= Q.HMAX
+    # unbagged rows are exact zeros in both lanes
+    assert not np.asarray(gd)[::5].any() and not np.asarray(hd)[::5].any()
+    # unbiased within a few quanta over the batch
+    assert abs(np.asarray(gd).sum() - np.asarray(gb).sum()) \
+        < 5 * float(sg) * np.sqrt(n)
+
+
+def test_packed_accumulation_count_exact(rng):
+    f, b = 5, 16
+    n = Q.PACKED_SAFE_ROWS                 # the full no-carry window
+    bins = rng.randint(0, b, size=(f, n)).astype(np.int32)
+    gq = rng.randint(-Q.GMAX, Q.GMAX + 1, size=n).astype(np.int32)
+    hq = rng.randint(0, Q.HMAX + 1, size=n).astype(np.int32)
+    word = np.asarray(Q.hist_accumulate_packed(
+        jnp.asarray(bins), Q.pack_gh(jnp.asarray(gq), jnp.asarray(hq)),
+        num_bins=b))
+    got_g, got_h = (np.asarray(a) for a in Q.unpack_gh(jnp.asarray(word)))
+    ref_g = np.zeros((f, b), np.int64)
+    ref_h = np.zeros((f, b), np.int64)
+    for j in range(f):
+        np.add.at(ref_g[j], bins[j], gq)
+        np.add.at(ref_h[j], bins[j], hq)
+    np.testing.assert_array_equal(got_g, ref_g)
+    np.testing.assert_array_equal(got_h, ref_h)
+
+
+def test_packed_chunked_exact_beyond_carry_window(rng):
+    """Every row in ONE bin with hq=HMAX drives the single-pass low half
+    past 2^16; the chunked accumulator must still be exact."""
+    f, b, n = 2, 4, 2 * 4096 + 123
+    bins = np.zeros((f, n), np.int32)
+    gq = np.full(n, -Q.GMAX, np.int32)
+    hq = np.full(n, Q.HMAX, np.int32)
+    assert n * Q.HMAX > (1 << 16), "fixture must overflow the window"
+    got_g, got_h = Q.hist_accumulate_packed_chunked(
+        jnp.asarray(bins), jnp.asarray(gq), jnp.asarray(hq), num_bins=b)
+    assert int(got_g[0, 0]) == -Q.GMAX * n
+    assert int(got_h[0, 0]) == Q.HMAX * n
+    assert not np.asarray(got_g)[:, 1:].any()
+
+
+def test_int16_exchange_tier_boundary():
+    n_edge = 32767 // Q.HMAX               # 2184: Σhq just fits int16
+    assert Q.exchange_tier(n_edge) == "int16"
+    assert Q.exchange_tier(n_edge + 1) == "f32"
+    assert Q.exchange_tier(256) == "int16"
+
+
+def test_pack_hist_int16_roundtrip(rng):
+    sg, sh = jnp.float32(0.125), jnp.float32(0.25)
+    gsum = rng.randint(-30000, 30000, size=(3, 8)).astype(np.float32)
+    hsum = rng.randint(0, 32000, size=(3, 8)).astype(np.float32)
+    cnt = rng.randint(0, 32000, size=(3, 8)).astype(np.float32)
+    hist = jnp.asarray(np.stack(
+        [gsum * 0.125, hsum * 0.25, cnt], axis=-1))
+    h16 = Q.pack_hist_int16(hist, 1.0 / sg, 1.0 / sh)
+    assert h16.dtype == jnp.int16
+    assert h16.dtype.itemsize * 2 == hist.dtype.itemsize  # half the wire
+    np.testing.assert_array_equal(
+        np.asarray(Q.unpack_hist_int16(h16, sg, sh)), np.asarray(hist))
+
+
+# -- eligibility gate --------------------------------------------------------
+
+def test_quant_ineligible_reasons():
+    assert Q.quant_ineligible_reason(4096, False) is None
+    assert "hist_dp" in Q.quant_ineligible_reason(4096, True)
+    big = Q.quant_ineligible_reason(Q.F32_EXACT_ROWS, False)
+    assert big is not None and str(Q.F32_EXACT_ROWS) in big
+
+
+def test_quant_gate_is_opt_in(rng):
+    X = rng.randn(512, 4)
+    y = (X[:, 0] > 0).astype(float)
+    auto = _booster(X, y, 1).gbdt.learner
+    assert not auto._quant
+    assert "opt-in" in auto._quant_reason
+    on = _booster(X, y, 1, tpu_quantized_grad="on").gbdt.learner
+    assert on._quant and on._quant_reason is None
+    # explicit 'on' with an ineligible config surfaces the gate reason
+    dp = _booster(X, y, 1, tpu_quantized_grad="on",
+                  gpu_use_dp=True).gbdt.learner
+    assert not dp._quant and "hist_dp" in dp._quant_reason
+
+
+# -- training contracts ------------------------------------------------------
+
+def test_quant_dyadic_round1_structure_matches_f32(rng):
+    """l2 on balanced y∈{0,1}: round-1 gradients are exactly ±0.5 and the
+    hessian is 1.0, so the pow2 scales quantize LOSSLESSLY — the first
+    tree's split structure must match the f32 learner bin-for-bin.  The
+    UNIFORM hessian also makes the normalized count channel (Σhq/m̄, see
+    ops/quant.py) equal the exact row count bitwise, so min_data_in_leaf
+    gates identically in both modes."""
+    X = rng.randn(1024, 6)
+    y = (np.arange(1024) % 2).astype(float)[np.argsort(rng.randn(1024))]
+    f32 = _booster(X, y, 1, objective="regression")
+    qnt = _booster(X, y, 1, objective="regression",
+                   tpu_quantized_grad="on")
+    tf, tq = f32.gbdt.models[0], qnt.gbdt.models[0]
+    np.testing.assert_array_equal(tf.split_feature, tq.split_feature)
+    np.testing.assert_array_equal(tf.threshold, tq.threshold)
+    # leaf outputs ride the fixed-point renewal grid: near-equal, not
+    # bitwise (the f32 learner sums in a different order)
+    np.testing.assert_allclose(tf.leaf_value, tq.leaf_value,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_quant_auc_within_contract(rng):
+    X = rng.randn(1024, 8)
+    y = (X[:, 0] + 0.5 * X[:, 1] + 0.2 * rng.randn(1024) > 0).astype(float)
+    f32 = _booster(X, y, 20)
+    qnt = _booster(X, y, 20, tpu_quantized_grad="on")
+    assert qnt.gbdt.learner._quant
+    a_f, a_q = _auc(y, f32.predict(X)), _auc(y, qnt.predict(X))
+    assert a_f > 0.9, "fixture must be learnable"
+    assert abs(a_f - a_q) <= 1e-3, (a_f, a_q)
+
+
+def test_donation_parity(rng):
+    X = rng.randn(512, 6)
+    y = (X[:, 0] + 0.3 * X[:, 2] > 0).astype(float)
+    base = _booster(X, y, 5, tpu_quantized_grad="on",
+                    tpu_donate_buffers="off")
+    don = _booster(X, y, 5, tpu_quantized_grad="on",
+                   tpu_donate_buffers="on")
+    assert don.gbdt.learner._donate and not base.gbdt.learner._donate
+    np.testing.assert_array_equal(base.predict(X), don.predict(X))
+
+
+def test_rf_boosting_disables_donation(rng):
+    X = rng.randn(512, 4)
+    y = (X[:, 0] > 0).astype(float)
+    bst = _booster(X, y, 2, boosting="rf", bagging_fraction=0.8,
+                   bagging_freq=1, tpu_donate_buffers="on")
+    # rf refits from ONE retained gradient set; donating would free it
+    assert not bst.gbdt.learner._donate
+
+
+# -- fused wave-step chain ---------------------------------------------------
+
+def _count_outside_kernels(jaxpr):
+    """Eqns recursing into control-flow bodies but NOT into pallas_call
+    kernels — each pallas_call counts once, as one launch.  Control-flow
+    params are ClosedJaxprs (.jaxpr); pallas_call carries a raw Jaxpr
+    (.eqns), which the skip above never reaches."""
+    n = 0
+    for eqn in jaxpr.eqns:
+        n += 1
+        if eqn.primitive.name == "pallas_call":
+            continue
+        for v in eqn.params.values():
+            vs = v if isinstance(v, (list, tuple)) else [v]
+            for s in vs:
+                inner = s if hasattr(s, "eqns") \
+                    else getattr(s, "jaxpr", None)
+                if inner is not None:
+                    n += _count_outside_kernels(inner)
+    return n
+
+
+def _trace_wave(rng_seed, fused):
+    rs = np.random.RandomState(rng_seed)
+    X = rs.randn(512, 4)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(float)
+    params = {"objective": "binary", "num_leaves": 15,
+              "min_data_in_leaf": 5, "verbosity": -1,
+              "tpu_quantized_grad": "on", "tpu_wave_pallas_scan": "on"}
+    ds = lgb.Dataset(X, label=y, params=params)
+    ds.construct()
+    ln = WaveTPUTreeLearner(Config.from_params(params), ds.constructed)
+    if not fused:
+        ln._fused_ok = lambda: False
+    z = jnp.zeros(ds.constructed.num_data_padded, jnp.float32)
+    fm = jnp.ones(ln.num_features, bool)
+    jx = jax.make_jaxpr(ln._train_tree_wave)(ln.bins_packed(), z, z, z, fm)
+    pallas = sum(1 for e in _iter(jx.jaxpr) if
+                 e.primitive.name == "pallas_call")
+    return pallas, _count_outside_kernels(jx.jaxpr)
+
+
+def _iter(jaxpr):
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for v in eqn.params.values():
+            vs = v if isinstance(v, (list, tuple)) else [v]
+            for s in vs:
+                inner = getattr(s, "jaxpr", None)
+                if inner is not None:
+                    yield from _iter(inner)
+
+
+def test_fused_chain_launches_fewer_kernels():
+    """The fused child-scan kernel absorbs the per-wave subtract /
+    FixHistogram / child-select glue INTO the Pallas launch: counting
+    every eqn as a kernel launch except pallas_call interiors (one launch
+    each), the fused step must be strictly smaller."""
+    p_f, k_f = _trace_wave(3, fused=True)
+    p_u, k_u = _trace_wave(3, fused=False)
+    assert p_f >= 1 and p_u >= 1, "both paths must use Pallas scans"
+    assert p_f <= p_u
+    assert k_f < k_u, (k_f, k_u)
+
+
+def test_fused_chain_bit_identical(rng):
+    X = rng.randn(512, 6)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(float)
+    params = dict(tpu_quantized_grad="on", tpu_wave_pallas_scan="on")
+    fused = _booster(X, y, 4, **params)
+    assert fused.gbdt.learner._use_fused
+
+    unf_params = {"objective": "binary", "num_leaves": 15,
+                  "min_data_in_leaf": 5, "verbosity": -1, **params}
+    ds = lgb.Dataset(X, label=y, params=unf_params)
+    unf = lgb.Booster(unf_params, ds)
+    unf.gbdt.learner._fused_ok = lambda: False
+    for _ in range(4):
+        unf.update()
+    assert not unf.gbdt.learner._use_fused
+    for ta, tb in zip(fused.gbdt.models, unf.gbdt.models):
+        np.testing.assert_array_equal(ta.split_feature, tb.split_feature)
+        np.testing.assert_array_equal(ta.threshold, tb.threshold)
+        np.testing.assert_array_equal(ta.leaf_value, tb.leaf_value)
+    np.testing.assert_array_equal(fused.predict(X), unf.predict(X))
+
+
+# -- sharded record-exactness + wire tier ------------------------------------
+
+needs_mesh = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs an 8-virtual-device mesh")
+
+
+def _train_mode(X, y, mode, mesh_shape=None, rounds=4):
+    from lightgbm_tpu.parallel.learners import apply_parallel_sharding
+    from lightgbm_tpu.parallel.sharding import (AXIS_DATA, AXIS_FEATURE,
+                                                make_mesh)
+    params = {"objective": "binary", "num_leaves": 15, "min_data_in_leaf": 5,
+              "verbosity": -1, "enable_bundle": False,
+              "tpu_quantized_grad": "on"}
+    if mode != "serial":
+        params["tree_learner"] = mode
+    ds = lgb.Dataset(X, label=y, params=params)
+    bst = lgb.Booster(params, ds)
+    if mode != "serial":
+        mesh = make_mesh(shape=mesh_shape,
+                         axis_names=(AXIS_DATA, AXIS_FEATURE)) \
+            if mesh_shape else make_mesh()
+        apply_parallel_sharding(bst.gbdt, mesh, mode)
+    for _ in range(rounds):
+        bst.update()
+    return bst
+
+
+@needs_mesh
+@pytest.mark.parametrize("mode,mesh_shape", [
+    ("data", None),
+    ("voting", None),
+    ("data_feature", (2, 2)),
+    ("data_feature", (2, 4)),
+], ids=["data", "voting", "2x2", "2x4"])
+def test_sharded_quant_record_exact(rng, mode, mesh_shape):
+    """Stochastic rounding keys on GLOBAL row indices and histogram sums
+    are integer multiples of the pow2 scale, so every sharded quantized
+    mode reproduces the serial quantized records BITWISE — including the
+    fixed-point-renewed leaf values — with the int16 wire tier engaged."""
+    X = rng.randn(2048, 16)
+    y = (X[:, 0] + 0.5 * X[:, 1] + 0.2 * rng.randn(2048) > 0).astype(float)
+    serial = _train_mode(X, y, "serial")
+    assert serial.gbdt.learner._quant
+    bst = _train_mode(X, y, mode, mesh_shape)
+    lq = bst.gbdt.learner
+    assert lq._quant
+    assert lq._wire_int16(), "int16 exchange tier must engage at n=2048"
+    for k, (ta, tb) in enumerate(zip(serial.gbdt.models, bst.gbdt.models)):
+        np.testing.assert_array_equal(ta.split_feature, tb.split_feature,
+                                      err_msg=f"tree {k}")
+        np.testing.assert_array_equal(ta.threshold, tb.threshold,
+                                      err_msg=f"tree {k}")
+        np.testing.assert_array_equal(ta.leaf_value, tb.leaf_value,
+                                      err_msg=f"tree {k}")
+    np.testing.assert_array_equal(serial.predict(X), bst.predict(X))
+
+
+def test_quant_exchange_payload_budget_halved():
+    """Acceptance bar: the pinned psum_scatter payload of the quantized
+    data-parallel program is at most HALF the f32 program's (int16 wire
+    vs f32) — `analysis/jaxpr_lint.py` re-checks this pair on every gate
+    run; this pins the committed budgets themselves."""
+    path = os.path.join(os.path.dirname(__file__), "..", "lightgbm_tpu",
+                        "analysis", "budgets.json")
+    with open(path) as fh:
+        budgets = json.load(fh)["programs"]
+    qb = budgets["wave_sharded_data_quant"]["collective_bytes"]
+    fb = budgets["wave_sharded_data"]["collective_bytes"]
+    assert 2 * qb["reduce_scatter"] <= fb["reduce_scatter"], (qb, fb)
