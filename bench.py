@@ -35,6 +35,16 @@ Usage: ``python bench.py``          — both scales, one JSON line.
        ``--quantized-grad MODE``     — ``tpu_quantized_grad`` passthrough
        (on/off/auto) so quantized-vs-f32 A/B legs land as driver-captured
        JSON lines (BENCH_r08); recorded in the ``metric`` string.
+       ``--num-hosts N --coordinator HOST:PORT --process-id R`` —
+       multi-host passthrough (`parallel/multihost.py`): the same bench
+       command runs on every pod host (only ``--process-id`` differs), the
+       mesh spans processes, and the host layout lands in the ``metric``
+       string.  ``--parallel-mesh`` should put the host count on the data
+       axis ("2x4" on 2 hosts x 4 local devices).
+       ``--out-of-core``             — write the synthetic problem to disk
+       once and ingest it through the streaming two-pass loader
+       (``two_round=true``, `dataset.py:from_stream`) instead of from
+       memory, so loader-path regressions show up in bench rounds.
 """
 
 import gc
@@ -47,7 +57,8 @@ import numpy as np
 
 
 def run_scale(rows: int, iters: int, warmup: int = 2,
-              telemetry: bool = False, extra_params: dict = None):
+              telemetry: bool = False, extra_params: dict = None,
+              out_of_core: bool = False):
     """Train steady-state iterations at one scale; returns
     (iters/sec, telemetry report or None)."""
     import lightgbm_tpu as lgb
@@ -64,7 +75,21 @@ def run_scale(rows: int, iters: int, warmup: int = 2,
               "verbosity": -1, "metric": "none", "telemetry": telemetry}
     if extra_params:
         params.update(extra_params)
-    ds = lgb.Dataset(X, label=y, params=params)
+    if out_of_core:
+        # spill the problem to disk, ingest through the streaming loader
+        import os
+        import tempfile
+
+        path = os.path.join(tempfile.mkdtemp(prefix="bench_ooc_"),
+                            "train.csv")
+        np.savetxt(path, np.column_stack([y, X]), delimiter=",",
+                   fmt="%.17g")
+        del X, y
+        gc.collect()
+        params["two_round"] = True
+        ds = lgb.Dataset(path, params=params)
+    else:
+        ds = lgb.Dataset(X, label=y, params=params)
     bst = lgb.Booster(params, ds)
 
     # the boosting loop is async (device-resident score updates, lazy host
@@ -81,7 +106,9 @@ def run_scale(rows: int, iters: int, warmup: int = 2,
     sync()
     dt = time.time() - t0
     report = bst.gbdt.get_telemetry() if telemetry else None
-    del bst, ds, X, y  # release device buffers before the next scale
+    del bst, ds  # release device buffers before the next scale
+    if not out_of_core:
+        del X, y
     gc.collect()
     return iters / dt, report
 
@@ -109,11 +136,20 @@ def _pop_opt_arg(argv, flag):
     return out, rest
 
 
+def _pop_flag(argv, flag):
+    """Extract a valueless ``--flag`` from an argv list."""
+    return flag in argv, [a for a in argv if a != flag]
+
+
 def main():
     telemetry_out, argv = _pop_opt_arg(sys.argv[1:], "--telemetry-out")
     tree_learner, argv = _pop_opt_arg(argv, "--tree-learner")
     parallel_mesh, argv = _pop_opt_arg(argv, "--parallel-mesh")
     quantized, argv = _pop_opt_arg(argv, "--quantized-grad")
+    num_hosts, argv = _pop_opt_arg(argv, "--num-hosts")
+    coordinator, argv = _pop_opt_arg(argv, "--coordinator")
+    process_id, argv = _pop_opt_arg(argv, "--process-id")
+    out_of_core, argv = _pop_flag(argv, "--out-of-core")
     telem = telemetry_out is not None
     extra = {}
     mode_tag = ""
@@ -126,12 +162,26 @@ def main():
     if quantized:
         extra["tpu_quantized_grad"] = quantized
         mode_tag += f", quantized_grad={quantized}"
+    if num_hosts or coordinator or process_id:
+        # multi-host passthrough: the same command runs on every pod host;
+        # resolve_multihost rejects a partial spec loudly rather than
+        # silently benching single-host
+        if coordinator:
+            extra["coordinator_address"] = coordinator
+        if num_hosts:
+            extra["num_hosts"] = int(num_hosts)
+        if process_id is not None:
+            extra["process_id"] = int(process_id)
+        mode_tag += (f", hosts={num_hosts or '?'}"
+                     f", host_rank={process_id or '?'}")
+    if out_of_core:
+        mode_tag += ", out_of_core"
     reports = {}
     if argv:  # single-scale profiling mode
         rows = int(argv[0])
         iters = int(argv[1]) if len(argv) > 1 else 10
         ips, rep = run_scale(rows, iters, telemetry=telem,
-                             extra_params=extra)
+                             extra_params=extra, out_of_core=out_of_core)
         if rep is not None:
             reports[str(rows)] = rep
         line = {
@@ -146,14 +196,19 @@ def main():
         # axon tunnel's flat ~105 ms device->host sync lands ONCE per timed
         # loop, so more steady-state iterations = closer to the reference's
         # methodology (at 10 iters the artifact alone was ~10.5 ms/iter, ~8%)
-        ips_1m, rep_1m = run_scale(1_000_000, 30, telemetry=telem)
-        ips_full, rep_full = run_scale(10_500_000, 6, telemetry=telem)
+        ips_1m, rep_1m = run_scale(1_000_000, 30, telemetry=telem,
+                                   extra_params=extra,
+                                   out_of_core=out_of_core)
+        ips_full, rep_full = run_scale(10_500_000, 6, telemetry=telem,
+                                       extra_params=extra,
+                                       out_of_core=out_of_core)
         if rep_1m is not None:
             reports["1000000"] = rep_1m
             reports["10500000"] = rep_full
         line = {
-            "metric": "boosting iters/sec (synthetic Higgs-like 1Mx28, "
-                      "255 leaves, 255 bins; _10p5m = reference row count)",
+            "metric": f"boosting iters/sec (synthetic Higgs-like 1Mx28, "
+                      f"255 leaves, 255 bins; _10p5m = reference row count"
+                      f"{mode_tag})",
             "value": round(ips_1m, 4),
             "unit": "iters/sec",
             "vs_baseline": round(ips_1m / ref_ips(1_000_000), 4),

@@ -61,6 +61,13 @@ PROGRAM_FILES = {
     "wave_sharded_voting": "lightgbm_tpu/parallel/wave_sharded.py",
     "wave_feature": "lightgbm_tpu/parallel/feature_sharded.py",
     "wave_sharded_2d": "lightgbm_tpu/parallel/wave2d_sharded.py",
+    # pod-shaped variants: the SAME programs traced at the 2-host virtual
+    # layout (`parallel/multihost.py` — 8 global devices = 2 hosts x 4
+    # local).  Collective structure must not change with host count (only
+    # shard widths do); a cross-host-only collective slipping in shows up
+    # as a site-count delta against these budgets.
+    "wave_sharded_data_pod": "lightgbm_tpu/parallel/wave_sharded.py",
+    "wave_sharded_2d_pod": "lightgbm_tpu/parallel/wave2d_sharded.py",
     "serving_bin": "lightgbm_tpu/serving/binner.py",
     "serving_traverse": "lightgbm_tpu/predictor.py",
 }
@@ -251,7 +258,7 @@ def _trace_wave_serial_quant():
         learner.bins_packed(), z, z, z, fmask)
 
 
-def _trace_wave_sharded(kind: str, quant: bool = False):
+def _trace_wave_sharded(kind: str, quant: bool = False, ndev: int = 2):
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
@@ -265,7 +272,7 @@ def _trace_wave_sharded(kind: str, quant: bool = False):
 
     params = dict(_BASE_PARAMS, enable_bundle=False)
     ds = _toy_dataset(2048, 8, params)
-    mesh = make_mesh(2)
+    mesh = make_mesh(ndev)
     cfg_params = dict(params, tree_learner={
         "data": "data", "voting": "voting", "feature": "feature"}[kind])
     if quant:
@@ -299,11 +306,12 @@ def _trace_wave_sharded(kind: str, quant: bool = False):
     return jax.make_jaxpr(fn)(learner.sharded_bins(), z, z, z, fmask_pad)
 
 
-def _trace_wave_sharded_2d():
-    """The 2-D hybrid wave tree step on a (data=2, feature=2) mesh.  The
+def _trace_wave_sharded_2d(shape: Tuple[int, int] = (2, 2)):
+    """The 2-D hybrid wave tree step on a (data, feature) mesh.  The
     toy dataset's 8 padded features pack to 2 words, so feature-axis=2 is
     the word-aligned tile limit at this width (tests use wider problems
-    for 2x4 shapes)."""
+    for 2x4 shapes); the pod variant scales the DATA axis instead
+    ((4, 2) — the 2-host x 4-local virtual layout, row axis host-major)."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
@@ -316,7 +324,7 @@ def _trace_wave_sharded_2d():
 
     params = dict(_BASE_PARAMS, enable_bundle=False)
     ds = _toy_dataset(2048, 8, params)
-    mesh = make_mesh(shape=(2, 2), axis_names=(AXIS_DATA, AXIS_FEATURE))
+    mesh = make_mesh(shape=shape, axis_names=(AXIS_DATA, AXIS_FEATURE))
     cfg = Config.from_params(dict(params, tree_learner="data_feature"))
     reason = wave2d_ineligible_reason(cfg, ds.constructed, mesh)
     assert reason is None, f"gate dataset ineligible for 2D: {reason}"
@@ -400,6 +408,13 @@ def program_builders(need_mesh_of: int = 2
         builders["wave_feature"] = lambda: _trace_wave_sharded("feature")
     if len(jax.devices()) >= 2 * need_mesh_of:
         builders["wave_sharded_2d"] = _trace_wave_sharded_2d
+    if len(jax.devices()) >= 8:
+        # pod shapes: the 2-host x 4-local virtual layout flattened onto
+        # the gate's 8 devices (1D data row axis, and a (4, 2) 2D mesh)
+        builders["wave_sharded_data_pod"] = \
+            lambda: _trace_wave_sharded("data", ndev=8)
+        builders["wave_sharded_2d_pod"] = \
+            lambda: _trace_wave_sharded_2d(shape=(4, 2))
     return builders
 
 

@@ -472,7 +472,10 @@ class GBDT:
                                      "data_feature") \
                 and len(jax.devices()) > 1:
             from ..parallel.learners import apply_parallel_sharding
-            from ..parallel.sharding import mesh_for_config
+            # multihost.mesh_for_config == sharding.mesh_for_config on one
+            # host; on a pod it resolves the parallel_mesh grammar over the
+            # GLOBAL device list and host-alignment-checks the row axis
+            from ..parallel.multihost import mesh_for_config
             apply_parallel_sharding(self, mesh_for_config(self.cfg),
                                     self.cfg.tree_learner)
 
@@ -537,27 +540,47 @@ class GBDT:
 
     # -- gradients -----------------------------------------------------------
 
+    #: objective attributes that hold row-aligned device arrays — the same
+    #: list `parallel/learners.py` shards over the mesh
+    _OBJ_ARRAYS = ("label", "weights", "trans_label", "label_sign",
+                   "label_w", "label_weight", "label_onehot")
+
     def _compute_gradients(self) -> Tuple[jnp.ndarray, jnp.ndarray]:
         """(K, N_pad) gradients/hessians from the objective (`gbdt.cpp:149`),
-        as ONE jitted dispatch (the objective's label arrays are closed over;
-        they are fixed for the life of the booster)."""
+        as ONE jitted dispatch.  The objective's row-aligned arrays enter as
+        jit ARGUMENTS, not closure constants: under a multi-process mesh
+        (`parallel/multihost.py`) they span non-addressable devices, and
+        closing over such an array is an error — passing them as args is
+        equivalent (they are fixed for the life of the booster) and legal
+        everywhere."""
         if self._jit_grad_fn is None:
             obj = self.objective
             K = self.num_tree_per_iteration
 
-            def grad_all(score):
-                if obj.name == "multiclass":
-                    return obj.get_gradients_all(score)
-                gs, hs = [], []
-                for k in range(K):
-                    g, h = obj.get_gradients(score[k], k)
-                    gs.append(g)
-                    hs.append(h)
-                return jnp.stack(gs), jnp.stack(hs)
+            def grad_all(score, arrs):
+                saved = {n: getattr(obj, n) for n in arrs}
+                for n, v in arrs.items():
+                    setattr(obj, n, v)
+                try:
+                    if obj.name == "multiclass":
+                        return obj.get_gradients_all(score)
+                    gs, hs = [], []
+                    for k in range(K):
+                        g, h = obj.get_gradients(score[k], k)
+                        gs.append(g)
+                        hs.append(h)
+                    return jnp.stack(gs), jnp.stack(hs)
+                finally:
+                    for n, v in saved.items():
+                        setattr(obj, n, v)
 
             self._jit_grad_fn = jax.jit(grad_all)
+        obj = self.objective
+        arrs = {n: getattr(obj, n) for n in self._OBJ_ARRAYS
+                if getattr(obj, n, None) is not None
+                and hasattr(getattr(obj, n), "shape")}
         with self.telemetry.phase("gradients"):
-            return self._jit_grad_fn(self.train_score.score)
+            return self._jit_grad_fn(self.train_score.score, arrs)
 
     # -- one boosting iteration (`gbdt.cpp:333-413`) -------------------------
 

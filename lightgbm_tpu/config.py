@@ -123,6 +123,12 @@ ALIAS_TABLE: Dict[str, str] = {
     "machine_list_file": "machine_list_filename",
     "machine_list": "machine_list_filename", "mlist": "machine_list_filename",
     "workers": "machines", "nodes": "machines",
+    # multi-host pod (parallel/multihost.py)
+    "coordinator": "coordinator_address",
+    "num_processes": "num_hosts", "num_process": "num_hosts",
+    # out-of-core streaming loader
+    "chunk_rows": "stream_chunk_rows",
+    "out_of_core": "two_round",
     # observability (so the CLI flags --stats-out / --stats-interval land
     # on the serve_* keys)
     "stats_out": "serve_stats_out",
@@ -271,7 +277,16 @@ class Config:
     sparse_threshold: float = 0.8
     use_missing: bool = True
     zero_as_missing: bool = False
+    # out-of-core streaming ingestion (`io/parser.py:iter_data_chunks` +
+    # `dataset.py:construct_streaming`): read the text file in passes of
+    # stream_chunk_rows-row chunks instead of materializing the full matrix
+    # — pass 1 counts rows, pass 2 collects the bin-finding sample, pass 3
+    # bins chunkwise straight into the packed device word layout.  Mappers,
+    # binned words, and trained models are bit-identical to the in-memory
+    # path (tests/test_out_of_core.py).  The reference's two_round flag
+    # (`config.h:227` use_two_round_loading) gates the same trade.
     two_round: bool = False
+    stream_chunk_rows: int = 65536
     save_binary: bool = False
     header: bool = False
     label_column: str = ""
@@ -321,6 +336,17 @@ class Config:
     time_out: int = 120
     machine_list_filename: str = ""
     machines: str = ""
+    # --- multi-host pod (parallel/multihost.py) ---
+    # jax.distributed coordinator "host:port"; empty = single-host (or the
+    # LGBT_COORDINATOR environment variable)
+    coordinator_address: str = ""
+    # number of participating host PROCESSES (LGBT_NUM_HOSTS); 1 = off.
+    # Distinct from num_machines, which is the loader-side row-shard count
+    # (`io/distributed.py`) — a 2-host pod normally runs num_hosts=2 with
+    # the dataset replicated or num_machines=2 with mod-partitioned shards.
+    num_hosts: int = 1
+    # this process's rank in [0, num_hosts); -1 = from LGBT_PROCESS_ID
+    process_id: int = -1
     # --- reliability (lightgbm_tpu/reliability/) ---
     # hard cap on a single SocketNet/serving wire frame: a corrupt length
     # prefix fails with a ConnectionError instead of a multi-GB allocation

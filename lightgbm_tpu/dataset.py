@@ -176,6 +176,30 @@ class Dataset:
                 if self._init_score is not None:
                     self._constructed.metadata.set_init_score(self._init_score)
                 return self
+            if isinstance(self._raw_data, str) and cfg.two_round \
+                    and self.reference is None:
+                # out-of-core two-pass streaming load (`two_round=true`,
+                # the reference's use_two_round_loading): the full float64
+                # matrix is never materialized — see
+                # `_ConstructedDataset.from_stream`
+                from .io.parser import scan_data_file
+                info = scan_data_file(self._raw_data, self.params)
+                shape_shim = type("_Shape", (), {
+                    "shape": (info.num_rows, info.num_features)})
+                self._constructed = _ConstructedDataset.from_stream(
+                    self._raw_data, self.params, cfg,
+                    categorical=self._resolve_categorical(shape_shim),
+                    feature_names=self._resolve_feature_names(shape_shim),
+                    info=info)
+                if self._label is not None:
+                    self._constructed.metadata.set_label(self._label)
+                if self._weight is not None:
+                    self._constructed.metadata.set_weights(self._weight)
+                if self._group is not None:
+                    self._constructed.metadata.set_group(self._group)
+                if self._init_score is not None:
+                    self._constructed.metadata.set_init_score(self._init_score)
+                return self
             if self.reference is not None:
                 # construct the reference FIRST: _data_from_pandas needs its
                 # recorded category lists to code this frame consistently
@@ -461,17 +485,34 @@ class _ConstructedDataset:
         # sample rows for bin finding (`dataset_loader.cpp:583-618`): the
         # reference samples `bin_construct_sample_cnt` rows with its own PRNG;
         # we use numpy's generator seeded with data_random_seed.
+        sample_idx = cls._sample_indices(n, cfg)
+        sample = mat if sample_idx is None else mat[sample_idx]
+
+        self._find_mappers(sample, cfg, categorical)
+        self._bin_all(mat, cfg)
+        return self
+
+    @staticmethod
+    def _sample_indices(n: int, cfg: Config) -> Optional[np.ndarray]:
+        """Row indices sampled for bin finding, or None for "all rows" —
+        ONE definition shared by the in-memory, out-of-core and distributed
+        (`io/distributed.py`) loaders so their mapper tables are
+        bit-identical by construction."""
         if n > cfg.bin_construct_sample_cnt:
             rng = np.random.RandomState(cfg.data_random_seed)
-            sample_idx = np.sort(rng.choice(n, cfg.bin_construct_sample_cnt, replace=False))
-            sample = mat[sample_idx]
-        else:
-            sample = mat
+            return np.sort(rng.choice(n, cfg.bin_construct_sample_cnt,
+                                      replace=False))
+        return None
 
+    def _find_mappers(self, sample: np.ndarray, cfg: Config,
+                      categorical) -> None:
+        """FindBin over the sample matrix → ``bin_mappers`` +
+        ``used_feature_map`` (trivial features dropped)."""
+        categorical = set(categorical)
         self.bin_mappers = []
         keep: List[int] = []
         from .binning import kZeroThreshold
-        for j in range(f):
+        for j in range(self.num_total_features):
             m = BinMapper()
             col = sample[:, j]
             # the reference samples only non-zero/NaN values and lets FindBin
@@ -489,7 +530,124 @@ class _ConstructedDataset:
                 keep.append(j)
                 self.bin_mappers.append(m)
         self.used_feature_map = np.asarray(keep, dtype=np.int32)
-        self._bin_all(mat, cfg)
+
+    @classmethod
+    def from_stream(cls, path: str, params: Optional[Dict], cfg: Config,
+                    categorical: Sequence[int] = (),
+                    feature_names: Optional[List[str]] = None,
+                    rank: int = 0, num_machines: int = 1,
+                    pre_partition: bool = False,
+                    info=None) -> "_ConstructedDataset":
+        """Out-of-core construction of a file-backed dataset (the reference's
+        ``two_round`` loading, `dataset_loader.cpp:133` + `config.h:227`,
+        re-shaped for the padded device word layout):
+
+          * pass 0 — ``scan_data_file``: row count + format, O(1) memory;
+          * pass 1 — stream chunks collecting ONLY the
+            ``bin_construct_sample_cnt`` sampled rows (the exact
+            ``_sample_indices`` sequence of the in-memory path), then FindBin
+            on that sample → mappers bit-identical to ``from_matrix``;
+          * pass 2 — re-stream, bin each chunk with the global mapper table,
+            keep rows with ``global_row % num_machines == rank``
+            (``CheckOrPartition`` mod-dealing; all rows when single-machine
+            or ``pre_partition``; whole-query dealing with a ``.query``
+            sidecar) and pack them straight into the padded ``bins`` words.
+
+        Peak host memory is O(chunk + sample + local binned shard) — the
+        full float64 matrix never exists.  Words and mappers are
+        bit-identical to ``from_matrix`` on the same file
+        (`tests/test_out_of_core.py`)."""
+        from .io.parser import _load_sidecar, iter_data_chunks, scan_data_file
+
+        params = dict(params or {})
+        if info is None:
+            info = scan_data_file(path, params)
+        n, f = info.num_rows, info.num_features
+        self = cls()
+        self.num_total_features = f
+        self.feature_names = list(feature_names) if feature_names \
+            else [f"Column_{i}" for i in range(f)]
+        self.config = cfg
+        chunk_rows = max(int(cfg.stream_chunk_rows), 1)
+
+        # ---- pass 1: the from_matrix sample, collected chunk-wise
+        sample_idx = self._sample_indices(n, cfg)
+        parts: List[np.ndarray] = []
+        for start, mat, _lab in iter_data_chunks(path, params, chunk_rows,
+                                                 info=info):
+            if sample_idx is None:
+                parts.append(mat)
+            else:
+                lo = np.searchsorted(sample_idx, start)
+                hi = np.searchsorted(sample_idx, start + len(mat))
+                if hi > lo:
+                    parts.append(mat[sample_idx[lo:hi] - start])
+        sample = np.concatenate(parts, axis=0) if parts \
+            else np.zeros((0, f), dtype=np.float64)
+        parts = None
+        self._find_mappers(sample, cfg, categorical)
+
+        # ---- row ownership (`io/distributed.py` partition semantics)
+        full_weight = _load_sidecar(path + ".weight")
+        full_group = _load_sidecar(path + ".query")
+        qgroup = None
+        if num_machines > 1 and not pre_partition:
+            if full_group is not None:
+                from .io.distributed import partition_queries
+                owned, qgroup = partition_queries(full_group, rank,
+                                                  num_machines)
+            else:
+                owned = np.arange(rank, n, num_machines, dtype=np.int64)
+        else:
+            owned = np.arange(n, dtype=np.int64)
+        if full_group is not None and int(np.sum(full_group)) != n:
+            raise ValueError(f"query file rows ({int(np.sum(full_group))}) "
+                             f"!= data rows ({n})")
+
+        # ---- pass 2: bin + pack owned rows directly into device words
+        n_local = len(owned)
+        self.num_data = n_local
+        block = max(int(cfg.tpu_row_block), 128)
+        self.num_data_padded = _round_up(max(n_local, 1), block)
+        self.max_num_bin = max((m.num_bin for m in self.bin_mappers),
+                               default=1)
+        dtype = np.uint8 if self.max_num_bin <= 256 else np.uint16
+        fu_pad = _round_up(max(len(self.bin_mappers), 1), self.FEATURE_TILE)
+        self.bins = np.zeros((fu_pad, self.num_data_padded), dtype=dtype)
+        labels = np.zeros(n_local, dtype=np.float64)
+        dst = 0
+        for start, mat, lab in iter_data_chunks(path, params, chunk_rows,
+                                                info=info):
+            lo = np.searchsorted(owned, start)
+            hi = np.searchsorted(owned, start + len(mat))
+            if hi <= lo:
+                continue
+            rows = owned[lo:hi] - start
+            sub = mat[rows]
+            for k, m in enumerate(self.bin_mappers):
+                j = int(self.used_feature_map[k])
+                self.bins[k, dst:dst + len(rows)] = \
+                    m.values_to_bins(sub[:, j]).astype(dtype)
+            labels[dst:dst + len(rows)] = lab[rows]
+            dst += len(rows)
+        if dst != n_local:
+            raise ValueError(f"stream produced {dst} owned rows, "
+                             f"expected {n_local} — file changed mid-load?")
+
+        self.metadata = Metadata(n_local)
+        self.metadata.set_label(labels)
+        if full_weight is not None:
+            self.metadata.set_weights(full_weight[owned])
+        if qgroup is not None:
+            self.metadata.set_group(qgroup)
+        elif full_group is not None:
+            self.metadata.set_group(full_group)
+        self.bundle = None
+        self._maybe_bundle(cfg, is_reference_linked=(num_machines > 1))
+        if num_machines > 1:
+            self.global_rows = owned
+            self.row_offset = 0
+            self.num_data_global = n
         return self
 
     @classmethod
@@ -529,12 +687,17 @@ class _ConstructedDataset:
             j = int(self.used_feature_map[k])
             self.bins[k, :n] = m.values_to_bins(mat[:, j]).astype(dtype)
         self.bundle = None
-        # bundles are consumed only by the TRAINING learner — valid sets
-        # (reference-linked) skip the exclusivity scan entirely
+        self._maybe_bundle(cfg, is_reference_linked=is_reference_linked)
+
+    def _maybe_bundle(self, cfg: Config, is_reference_linked: bool = False
+                      ) -> None:
+        """EFB over the binned matrix, gated exactly as the serial training
+        path consumes it — valid sets (reference-linked) and rank-local
+        shards skip the exclusivity scan entirely."""
         if not is_reference_linked \
                 and cfg.enable_bundle and cfg.tree_learner == "serial" \
                 and cfg.tpu_learner in ("auto", "wave", "compact") \
-                and self.max_num_bin <= 256 and fu > 1:
+                and self.max_num_bin <= 256 and len(self.bin_mappers) > 1:
             from .efb import find_bundles, apply_bundles
             groups = find_bundles(self, cfg)
             if any(len(g) > 1 for g in groups):

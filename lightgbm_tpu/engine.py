@@ -38,6 +38,13 @@ class Booster:
         self.best_score: Dict[str, Dict[str, float]] = {}
         self._train_set = train_set
         self.gbdt: Optional[GBDT] = None
+        # multi-host pod: join the jax.distributed cluster BEFORE the first
+        # device touch (dataset construct uploads arrays); the per-iteration
+        # liveness heartbeat rides the same coordinator (parallel/multihost)
+        self._mh_net = None
+        from .parallel import multihost
+        if multihost.initialize_from_config(self.cfg) and train_set is not None:
+            self._mh_net = multihost.net_for_run(self.cfg)
         if train_set is not None:
             import time as _time
             _t0 = _time.perf_counter()
@@ -85,6 +92,12 @@ class Booster:
                fobj: Optional[Callable] = None) -> bool:
         """One boosting iteration (`basic.py:1842`); returns True if training
         should stop."""
+        if self._mh_net is not None:
+            # pre-step liveness agreement: a host that died since the last
+            # iteration surfaces HERE as a ConnectionError naming the dead
+            # rank (within the collective deadline) instead of a hang
+            # inside the next XLA collective
+            self._mh_net.heartbeat(self.gbdt.iter_)
         if fobj is None:
             return self.gbdt.train_one_iter()
         grad, hess = fobj(self._curr_preds(), self._train_set)

@@ -72,12 +72,24 @@ class LoopbackCluster:
         for t in threads:
             t.join()
         # a rank failure aborts the barrier, so OTHER ranks die with a
-        # secondary BrokenBarrierError — surface the root cause instead
-        root = [e for e in errors
+        # secondary BrokenBarrierError — surface the root cause(s) instead.
+        # MULTIPLE ranks can fail for independent reasons in one run (e.g.
+        # two ranks fed corrupt shards); raising only the first would lose
+        # the rest, so every root cause is aggregated into the message.
+        root = [(r, e) for r, e in enumerate(errors)
                 if e is not None
                 and not isinstance(e, threading.BrokenBarrierError)]
+        if len(root) == 1:
+            raise root[0][1]
         if root:
-            raise root[0]
+            summary = "; ".join(
+                f"rank {r}: {type(e).__name__}: {e}" for r, e in root)
+            msg = f"{len(root)} ranks failed — {summary}"
+            try:
+                agg = type(root[0][1])(msg)
+            except Exception:   # exception types with exotic signatures
+                agg = RuntimeError(msg)
+            raise agg from root[0][1]
         for e in errors:
             if e is not None:
                 raise e

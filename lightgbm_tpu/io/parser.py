@@ -82,6 +82,143 @@ def load_data_file(path: str, params: Optional[Dict] = None
     return mat, label, weight, group
 
 
+class StreamInfo:
+    """Shape/format facts from one cheap ``scan_data_file`` pass — all the
+    out-of-core loader needs to size its buffers and sample indices before
+    any matrix data is materialized."""
+
+    __slots__ = ("kind", "delim", "has_header", "label_idx", "num_rows",
+                 "num_features")
+
+    def __init__(self, kind: str, delim: str, has_header: bool,
+                 label_idx: int, num_rows: int, num_features: int):
+        self.kind = kind
+        self.delim = delim
+        self.has_header = has_header
+        self.label_idx = label_idx
+        self.num_rows = num_rows
+        self.num_features = num_features
+
+
+def _resolve_label_idx(params: Dict) -> int:
+    label_column = params.get("label_column", params.get("label", ""))
+    if isinstance(label_column, str) and label_column.startswith("column_"):
+        return int(label_column.split("_", 1)[1])
+    return 0
+
+
+def scan_data_file(path: str, params: Optional[Dict] = None) -> StreamInfo:
+    """Pass 0 of the out-of-core loader: stream the file once counting data
+    rows and detecting the format (`_detect_format` on the first data line,
+    exactly like ``load_data_file``); for LibSVM also the max feature index,
+    which in-memory loading infers from the full parse.  O(1) memory."""
+    params = params or {}
+    has_header = str(params.get("header", params.get("has_header", "false"))
+                     ).lower() in ("true", "1")
+    kind = delim = None
+    n = 0
+    ncols = 0
+    max_feat = -1
+    header_skipped = not has_header
+    with open(path) as fh:
+        for raw in fh:
+            if not raw.strip():
+                continue
+            if not header_skipped:
+                header_skipped = True
+                continue
+            ln = raw.rstrip("\n\r")
+            if kind is None:
+                kind, delim = _detect_format([ln])
+            if kind == "libsvm":
+                for tok in ln.split()[1:]:
+                    if ":" in tok:
+                        k = int(tok.split(":", 1)[0])
+                        if k > max_feat:
+                            max_feat = k
+            elif n == 0:
+                toks = ln.split() if delim == " " \
+                    else ln.rstrip(delim).split(delim)
+                ncols = len(toks)
+            n += 1
+    if kind is None:
+        raise ValueError(f"no data rows in {path}")
+    label_idx = _resolve_label_idx(params)
+    num_features = (max_feat + 1) if kind == "libsvm" else max(ncols - 1, 0)
+    return StreamInfo(kind, delim, has_header, label_idx, n, num_features)
+
+
+def _parse_chunk(lines, info: StreamInfo
+                 ) -> Tuple[np.ndarray, np.ndarray]:
+    """One chunk of data lines → (matrix, label), with the SAME parse
+    expressions as ``load_data_file``'s numpy path so every float is
+    bit-identical to an in-memory load of the whole file."""
+    if info.kind == "libsvm":
+        labels = np.empty(len(lines), dtype=np.float64)
+        mat = np.zeros((len(lines), info.num_features), dtype=np.float64)
+        for i, ln in enumerate(lines):
+            toks = ln.split()
+            labels[i] = float(toks[0])
+            for tok in toks[1:]:
+                if ":" not in tok:
+                    continue
+                k, v = tok.split(":", 1)
+                mat[i, int(k)] = float(v)
+        return mat, labels
+    delim = info.delim
+    if delim == " ":
+        tok_rows = (ln.split() for ln in lines)
+    else:
+        tok_rows = (ln.rstrip(delim).split(delim) for ln in lines)
+    # column count is fixed by the scan's first data line; ragged rows pad
+    # with NaN / truncate, matching the native parser (`native/parse.cpp`
+    # parse_line) so streaming equals in-memory on the same file
+    ncols = info.num_features + 1
+    mat = np.full((len(lines), ncols), np.nan, dtype=np.float64)
+    for i, toks in enumerate(tok_rows):
+        if len(toks) == ncols:
+            mat[i] = np.fromiter(
+                (float(x) if x.strip() else np.nan for x in toks),
+                dtype=np.float64, count=ncols)
+        else:
+            for c, x in enumerate(toks[:ncols]):
+                if x.strip():
+                    mat[i, c] = float(x)
+    label = mat[:, info.label_idx].copy()
+    mat = np.delete(mat, info.label_idx, axis=1)
+    return mat, label
+
+
+def iter_data_chunks(path: str, params: Optional[Dict] = None,
+                     chunk_rows: int = 65536,
+                     info: Optional[StreamInfo] = None):
+    """Stream a text data file as ``(start_row, matrix, label)`` chunks of at
+    most ``chunk_rows`` rows — the re-streaming passes of the out-of-core
+    loader (`dataset.py:from_stream`).  Peak memory is one chunk; the
+    concatenation of all chunks equals ``load_data_file``'s (matrix, label)
+    bit-for-bit."""
+    if info is None:
+        info = scan_data_file(path, params)
+    chunk_rows = max(int(chunk_rows), 1)
+    start = 0
+    buf: list = []
+    header_skipped = not info.has_header
+    with open(path) as fh:
+        for raw in fh:
+            if not raw.strip():
+                continue
+            if not header_skipped:
+                header_skipped = True
+                continue
+            buf.append(raw.rstrip("\n\r"))
+            if len(buf) >= chunk_rows:
+                yield (start, *_parse_chunk(buf, info))
+                start += len(buf)
+                buf = []
+    if buf:
+        yield (start, *_parse_chunk(buf, info))
+
+
 def _parse_libsvm(lines) -> Tuple[np.ndarray, np.ndarray]:
     labels = np.empty(len(lines), dtype=np.float64)
     rows = []

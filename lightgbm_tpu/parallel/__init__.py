@@ -4,3 +4,4 @@ from .sharding import (AXIS_DATA, AXIS_FEATURE, PlacementRules, make_mesh,
 from .mesh import shard_dataset
 from .learners import (make_data_parallel, make_feature_parallel,
                        make_hybrid_parallel, apply_parallel_sharding)
+from . import multihost

@@ -1,0 +1,293 @@
+"""Multi-host pod training over ``jax.distributed``.
+
+Lifts the PR 9 mesh/sharding layer (`parallel/sharding.py`) from one host
+to a pod: ``jax.distributed.initialize`` wiring from config keys +
+environment, global device discovery, a host-alignment check for the
+cross-host mesh, and a :class:`DistributedNet` that backs the
+`io/distributed.py` allgather/sync_min/sync_max seam with the
+jax.distributed coordinator's key-value store (SocketNet stays as the
+loader-side fallback seam it was built for — `ROADMAP.md` item 2).
+
+The crucial property, proven by `tests/test_multihost.py` on a CPU
+emulation (N processes x ``--xla_force_host_platform_device_count`` local
+devices against a local coordinator): because every sharded learner
+already expresses its collectives through the mesh, the SAME jitted
+programs run unchanged on a global mesh spanning processes — a 2-process x
+4-device run trains byte-identical models to a 1-process x 8-device run
+(with ``tpu_hist_dtype=float64`` accounting; f32 differs only in
+summation-order ulps).  On CPU the cross-process collectives need jax's
+gloo backend, enabled here before ``initialize``.
+
+Config / environment contract (config keys win; env fills the gaps so one
+launch recipe works for every rank)::
+
+    coordinator_address = host:port     # or LGBT_COORDINATOR
+    num_hosts           = N             # or LGBT_NUM_HOSTS
+    process_id          = r             # or LGBT_PROCESS_ID
+
+Launch recipe (same command on every host, only the rank differs)::
+
+    LGBT_COORDINATOR=10.0.0.1:12421 LGBT_NUM_HOSTS=2 LGBT_PROCESS_ID=$R \\
+        python -m lightgbm_tpu.cli task=train data=... tree_learner=data
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import time
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+ENV_COORDINATOR = "LGBT_COORDINATOR"
+ENV_NUM_HOSTS = "LGBT_NUM_HOSTS"
+ENV_PROCESS_ID = "LGBT_PROCESS_ID"
+
+_initialized = False
+_ns_counts: dict = {}
+
+
+def resolve_multihost(cfg=None) -> Optional[Tuple[str, int, int]]:
+    """(coordinator_address, num_processes, process_id) this run asks for,
+    or None for a single-host run.  Config keys win over the LGBT_*
+    environment; a partial spec (hosts without coordinator, rank out of
+    range) is an error, not a silent single-host fallback."""
+    coord = str(getattr(cfg, "coordinator_address", "") or
+                os.environ.get(ENV_COORDINATOR, "")).strip()
+    nproc = int(getattr(cfg, "num_hosts", 1) or 1)
+    if nproc <= 1:
+        nproc = int(os.environ.get(ENV_NUM_HOSTS, "1") or 1)
+    pid = int(getattr(cfg, "process_id", -1) if cfg is not None else -1)
+    if pid < 0:
+        pid = int(os.environ.get(ENV_PROCESS_ID, "-1") or -1)
+    if nproc <= 1 and not coord:
+        return None
+    if nproc <= 1 or not coord or pid < 0:
+        raise ValueError(
+            "multi-host run under-specified: need coordinator_address "
+            f"({coord!r}), num_hosts ({nproc}), process_id ({pid}) — set "
+            "the config keys or LGBT_COORDINATOR/LGBT_NUM_HOSTS/"
+            "LGBT_PROCESS_ID")
+    if pid >= nproc:
+        raise ValueError(f"process_id {pid} out of range for num_hosts "
+                         f"{nproc}")
+    return coord, nproc, pid
+
+
+def is_initialized() -> bool:
+    return _initialized
+
+
+def initialize_from_config(cfg=None) -> bool:
+    """Idempotent ``jax.distributed.initialize`` from config + env; returns
+    True when this process is part of a multi-host pod.  Must run before
+    the first device use (jax backends are configured at first touch); on
+    the CPU backend the gloo cross-process collectives are enabled first —
+    without them multi-process programs fail with "Multiprocess
+    computations aren't implemented on the CPU backend"."""
+    global _initialized
+    spec = resolve_multihost(cfg)
+    if spec is None:
+        return False
+    if _initialized:
+        return True
+    coord, nproc, pid = spec
+    import jax
+    if str(os.environ.get("JAX_PLATFORMS", "")).startswith("cpu") or \
+            str(jax.config.jax_platforms or "").startswith("cpu"):
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    jax.distributed.initialize(coordinator_address=coord,
+                               num_processes=nproc, process_id=pid)
+    _initialized = True
+    return True
+
+
+def _kv_client():
+    from jax._src.distributed import global_state
+    client = getattr(global_state, "client", None)
+    if client is None:
+        raise RuntimeError(
+            "jax.distributed is not initialized — call "
+            "multihost.initialize_from_config(cfg) (or set "
+            "coordinator_address/num_hosts/process_id) first")
+    return client
+
+
+def host_layout() -> Tuple[int, int, int]:
+    """(process_count, process_index, local_device_count) — the host
+    layout string recorded in bench/MULTICHIP metric lines."""
+    import jax
+    return jax.process_count(), jax.process_index(), jax.local_device_count()
+
+
+def mesh_for_config(cfg, devices=None):
+    """The `parallel/sharding.py` mesh grammar laid across hosts: the
+    ``parallel_mesh`` spec (e.g. ``"2x8"`` on 2 hosts x 8 local devices)
+    is resolved over the GLOBAL device list, and the resulting mesh is
+    checked for host alignment — each process's local devices must occupy
+    contiguous blocks of the row (data) axis, so every host's row shard of
+    a ``P(..., "data")``-sharded array is host-local.  jax orders
+    ``jax.devices()`` process-major, so any factorization whose trailing
+    axes divide the local device count is aligned."""
+    from .sharding import mesh_for_config as _local_mesh_for_config
+    from .sharding import row_axis
+    import jax
+
+    mesh = _local_mesh_for_config(cfg, devices=devices)
+    if jax.process_count() <= 1:
+        return mesh
+    ax = row_axis(mesh)
+    arr = mesh.devices
+    # collapse every non-row axis; each row-coordinate slice should sit on
+    # as few processes as possible, and process blocks must be contiguous
+    # along the row axis (row shard r on host r // (rows_per_host))
+    order = [mesh.axis_names.index(ax)] + [
+        i for i in range(arr.ndim) if i != mesh.axis_names.index(ax)]
+    by_row = np.transpose(arr, order).reshape(arr.shape[order[0]], -1)
+    first_proc = [min(d.process_index for d in row) for row in by_row]
+    if any(first_proc[i] > first_proc[i + 1]
+           for i in range(len(first_proc) - 1)):
+        import warnings
+        warnings.warn(
+            f"mesh {dict(zip(mesh.axis_names, arr.shape))} scatters row "
+            f"shards across hosts non-contiguously (row->host "
+            f"{first_proc}); cross-host transfers will dominate — prefer a "
+            f"parallel_mesh whose data axis is host-major, e.g. "
+            f"\"{jax.process_count()}x{jax.local_device_count()}\"")
+    return mesh
+
+
+class DistributedNet:
+    """`io/distributed.py` net seam (allgather / sync_min / sync_max) over
+    the jax.distributed coordinator's key-value store.
+
+    Payloads are pickled to seq-numbered per-rank keys and read back with a
+    deadline; a rank that never posts (crashed, partitioned) surfaces as a
+    ``ConnectionError`` NAMING the missing rank(s) on every survivor within
+    the deadline — the `reliability/faults.py` ``net.crash`` chaos point is
+    compiled into the collective entry exactly as in SocketNet, so the PR 4
+    rank-crash drills drive this path too (`tests/test_multihost.py`).
+
+    This is the loader/heartbeat side-channel only: the histogram and
+    split-vote traffic of the sharded learners rides the mesh collectives
+    of their jitted programs, never this store.
+    """
+
+    def __init__(self, cfg=None, rank: Optional[int] = None,
+                 num_machines: Optional[int] = None,
+                 deadline_s: Optional[float] = None,
+                 namespace: str = "lgbt"):
+        import jax
+        self.rank = int(jax.process_index() if rank is None else rank)
+        self.num_machines = int(jax.process_count()
+                                if num_machines is None else num_machines)
+        if deadline_s is None:
+            deadline_s = float(getattr(cfg, "net_collective_deadline_s", 0.0)
+                               or 0.0)
+            if deadline_s <= 0.0:
+                deadline_s = float(getattr(cfg, "time_out", 120) or 120)
+        self.deadline_s = float(deadline_s)
+        # distinct key prefix per net instance: the lagged GC leaves each
+        # net's FINAL round keys behind, and a later net restarting _seq at
+        # 1 would collide with them (ALREADY_EXISTS from the coordinator).
+        # Safe because every rank constructs nets in the same order — one
+        # per Booster — so the counter agrees pod-wide.
+        n = _ns_counts.get(namespace, 0)
+        _ns_counts[namespace] = n + 1
+        self._ns = f"{namespace}.{n}" if n else namespace
+        self._seq = 0
+        self._client = _kv_client()
+
+    # -- the three seam calls (`io/distributed.py` LoopbackCluster parity) --
+
+    def allgather(self, obj) -> List:
+        from ..reliability import faults
+
+        self._seq += 1
+        seq = self._seq
+        prefix = f"{self._ns}/ag{seq}/"
+        if faults.fire("net.crash", rank=self.rank) is not None:
+            # hard exit mid-collective — the PR 4 rank-death drill.  The
+            # survivors' deadline scan below must name THIS rank.
+            os._exit(17)
+        self._client.key_value_set_bytes(prefix + f"r{self.rank}",
+                                         pickle.dumps(obj))
+        deadline_ms = max(int(self.deadline_s * 1000), 1)
+        out: List = [None] * self.num_machines
+        for r in range(self.num_machines):
+            key = prefix + f"r{r}"
+            try:
+                out[r] = pickle.loads(
+                    self._client.blocking_key_value_get_bytes(
+                        key, deadline_ms))
+            except Exception as e:
+                from ..reliability.metrics import rel_inc
+                missing, report = self._missing_report(prefix)
+                rel_inc("net.multihost_collective_timeouts")
+                rel_inc("net.multihost_peers_dead", max(len(missing), 1))
+                raise ConnectionError(
+                    f"multihost collective #{seq} timed out after "
+                    f"{self.deadline_s:.1f}s on rank {self.rank}: "
+                    f"{report} (coordinator error: {e})") from None
+        # best-effort GC, lagged ONE round: rank r posting for round N proves
+        # its round N-1 allgather returned, i.e. it read every N-1 key — so
+        # only once ALL ranks posted round N are round N-1's keys dead.
+        # Deleting round N here instead races peers still reading it.
+        if self.rank == 0 and seq > 1:
+            try:
+                self._client.key_value_delete(f"{self._ns}/ag{seq - 1}/")
+            except Exception:
+                pass
+        return out
+
+    def sync_min(self, v: int) -> int:
+        return min(self.allgather(int(v)))
+
+    def sync_max(self, v: int) -> int:
+        return max(self.allgather(int(v)))
+
+    # -- liveness ----------------------------------------------------------
+
+    def heartbeat(self, tag: int = 0) -> None:
+        """One tiny allgather: every live rank agrees everyone is still
+        here, and a dead rank is NAMED within the collective deadline.  The
+        boosting loop runs this before each iteration's jitted step
+        (`engine.py`), so a host crash surfaces as a root-caused
+        ConnectionError instead of a hang inside an XLA collective."""
+        self.allgather(("hb", int(self.rank), int(tag)))
+
+    def _missing_report(self, prefix: str):
+        """(missing_ranks, message): which ranks never posted their payload
+        for ``prefix`` — the named root cause on every survivor."""
+        try:
+            posted = set()
+            for key in self._client.key_value_dir_get_bytes(prefix) or []:
+                name = key[0] if isinstance(key, tuple) else key
+                name = str(name).rsplit("/", 1)[-1]
+                if name.startswith("r"):
+                    posted.add(int(name[1:]))
+            missing = sorted(set(range(self.num_machines)) - posted)
+            if missing:
+                return missing, (
+                    "rank(s) " + ", ".join(map(str, missing)) +
+                    " never posted — process(es) dead or partitioned")
+            return [], "all ranks posted late (coordinator stall?)"
+        except Exception as e:  # pragma: no cover — coordinator itself gone
+            return [], f"missing-rank scan failed: {e}"
+
+    def barrier(self, name: str) -> None:
+        self._client.wait_at_barrier(
+            f"{self._ns}/{name}", max(int(self.deadline_s * 1000), 1))
+
+    def close(self) -> None:  # seam parity with SocketNet
+        pass
+
+
+def net_for_run(cfg) -> Optional[DistributedNet]:
+    """The loader/heartbeat net for this run: a :class:`DistributedNet`
+    when the pod is initialized, else None (SocketNet via
+    `io/net.py:net_from_config` remains the socket-only fallback)."""
+    if not _initialized:
+        return None
+    return DistributedNet(cfg)

@@ -1,0 +1,160 @@
+"""Subprocess worker for tests/test_multihost.py — one emulated pod host.
+
+Launched N times against a local coordinator; each process forces
+``JAX_PLATFORMS=cpu`` with ``--xla_force_host_platform_device_count=K``
+local virtual devices, so ``jax.distributed.initialize`` (gloo CPU
+collectives, wired by `parallel/multihost.py:initialize_from_config` from
+the config keys) yields a genuine N-process x K-device global platform.
+
+The spec (one JSON argv) selects a job:
+
+  * ``train`` — train the deterministic gate problem through the plain
+    Booster API for each requested tree_learner mode; report the model text,
+    the routed learner class, the host layout, a recompile-sentinel verdict
+    over the warmed multi-host step, and a DistributedNet
+    allgather/sync/barrier exercise;
+  * ``chaos`` — no training: heartbeat over the coordinator KV store until
+    the armed `reliability/faults.py` ``net.crash`` clause kills this rank
+    (os._exit(17)) or a peer's death surfaces as the named-root-cause
+    ConnectionError; survivors report the error text, elapsed time, and
+    reliability counters.
+
+Results are written as JSON to ``spec["out"]``.
+"""
+
+import json
+import os
+import sys
+import time
+
+
+def _setup(spec):
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = ("--xla_force_host_platform_device_count="
+                               f"{spec['local_devices']}")
+    if spec.get("faults"):
+        os.environ["LGBT_FAULTS"] = spec["faults"]
+    import jax
+    jax.config.update("jax_enable_x64", True)
+    # share the suite's persistent compile cache (tests/conftest.py): the
+    # pod processes compile the same programs as the in-process tests
+    cache = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         ".jax_cache")
+    jax.config.update("jax_compilation_cache_dir", cache)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def _problem(seed=0, n=600, f=30):
+    import numpy as np
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    y = (X[:, 0] + np.sin(X[:, 1]) + 0.3 * rng.randn(n) > 0).astype(float)
+    return X, y
+
+
+def _pod_params(spec, mode):
+    params = {"objective": "binary", "num_leaves": 7, "max_bin": 31,
+              "min_data_in_leaf": 5, "verbosity": -1, "metric": "none",
+              "tree_learner": mode, "parallel_mesh": spec["mesh"],
+              # f64 histogram accounting makes the cross-process reduction
+              # order immaterial: model text is BYTE-identical to the
+              # single-host run (f32 differs in summation-order ulps)
+              "tpu_hist_dtype": "float64", "tpu_double_precision": True}
+    if spec["num_hosts"] > 1:
+        params.update({
+            "coordinator_address": f"127.0.0.1:{spec['port']}",
+            "num_hosts": spec["num_hosts"],
+            "process_id": spec["rank"]})
+    return params
+
+
+def _job_train(spec):
+    import jax
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.analysis.recompile import (RecompileSentinel,
+                                                 _learner_jits)
+    from lightgbm_tpu.parallel import multihost
+
+    X, y = _problem()
+    out = {"rank": spec["rank"], "modes": {}}
+    iters = int(spec.get("iters", 6))
+    for mode in spec["modes"]:
+        params = _pod_params(spec, mode)
+        ds = lgb.Dataset(X, label=y, params=params)
+        bst = lgb.Booster(params, ds)
+        for _ in range(2):                       # warm the wave program
+            bst.update()
+        sentinel = RecompileSentinel()
+        for name, fn in _learner_jits(bst.gbdt.learner).items():
+            sentinel.register(name, fn)
+        sentinel.arm()
+        for _ in range(iters - 2):
+            bst.update()
+        retraces = [f.message for f in sentinel.check()] \
+            if sentinel.supported() else None
+        out["modes"][mode] = {
+            "model": bst.model_to_string(),
+            "learner": type(bst.gbdt.learner).__name__,
+            "retraces": retraces,
+            "heartbeats": (bst._mh_net._seq if bst._mh_net is not None
+                           else None),
+        }
+    out["process_count"] = jax.process_count()
+    out["process_index"] = jax.process_index()
+    out["device_count"] = jax.device_count()
+    out["local_device_count"] = jax.local_device_count()
+    # -- DistributedNet seam exercise (loader-side collectives)
+    if spec["num_hosts"] > 1:
+        net = multihost.DistributedNet(namespace="probe")
+        gathered = net.allgather(("hello", spec["rank"]))
+        out["net"] = {
+            "allgather": gathered,
+            "sync_min": net.sync_min(100 + spec["rank"]),
+            "sync_max": net.sync_max(100 + spec["rank"]),
+        }
+        net.barrier("probe-done")
+    return out
+
+
+def _job_chaos(spec):
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.parallel import multihost
+    from lightgbm_tpu.reliability.metrics import rel_counters
+
+    cfg = Config.from_params({
+        "coordinator_address": f"127.0.0.1:{spec['port']}",
+        "num_hosts": spec["num_hosts"], "process_id": spec["rank"],
+        "net_collective_deadline_s": spec.get("deadline_s", 10)})
+    assert multihost.initialize_from_config(cfg)
+    net = multihost.DistributedNet(cfg, namespace="chaos")
+    t0 = time.time()
+    out = {"rank": spec["rank"], "survived_error": None}
+    try:
+        for i in range(int(spec.get("beats", 6))):
+            net.heartbeat(i)
+        out["beats_completed"] = True
+    except ConnectionError as e:
+        out["survived_error"] = str(e)
+        out["elapsed_s"] = round(time.time() - t0, 3)
+    out["rel_counters"] = rel_counters()
+    return out
+
+
+def main():
+    spec = json.loads(sys.argv[1])
+    _setup(spec)
+    job = {"train": _job_train, "chaos": _job_chaos}[spec.get("job", "train")]
+    out = job(spec)
+    with open(spec["out"], "w") as fh:
+        json.dump(out, fh)
+    print(f"rank {spec['rank']} ok", flush=True)
+    if spec.get("job") == "chaos":
+        # skip jax.distributed's atexit shutdown barrier: with a peer
+        # deliberately dead it SIGABRTs the survivors after their report
+        os._exit(0)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,212 @@
+"""Multi-host pod emulation: N coordinator-connected CPU processes.
+
+The tier-1 proof behind `parallel/multihost.py`: a 2-process x 4-device
+pod (gloo CPU collectives, local coordinator) trains BYTE-IDENTICAL models
+to a single 8-device host for both sharded modes — the mesh/sharding layer
+really is host-transparent — and a killed host process surfaces as a
+named-root-cause ConnectionError on every survivor within the collective
+deadline (the PR 4 rank-crash drill, now across real process boundaries).
+
+Workers run `tests/_multihost_worker.py` as subprocesses (jax.distributed
+allows one initialize per process); they share the suite's persistent
+compile cache so warm runs skip XLA.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.parallel import multihost
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+WORKER = os.path.join(HERE, "_multihost_worker.py")
+
+MODES = ("data", "data_feature")
+ITERS = 6
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _clean_env():
+    env = dict(os.environ)
+    for k in (multihost.ENV_COORDINATOR, multihost.ENV_NUM_HOSTS,
+              multihost.ENV_PROCESS_ID, "LGBT_FAULTS"):
+        env.pop(k, None)
+    return env
+
+
+def _run_pod(specs, timeout_s):
+    """Launch one worker per spec, wait for all, return their JSON reports
+    keyed by rank (reports of ranks that wrote none are None)."""
+    env = _clean_env()
+    procs = [subprocess.Popen(
+        [sys.executable, WORKER, json.dumps(spec)], env=env,
+        cwd=os.path.dirname(HERE), stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True) for spec in specs]
+    try:
+        tails = [p.communicate(timeout=timeout_s)[0] for p in procs]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    out = {}
+    for spec, p, tail in zip(specs, procs, tails):
+        report = None
+        if os.path.exists(spec["out"]):
+            with open(spec["out"]) as fh:
+                report = json.load(fh)
+        out[spec["rank"]] = (p.returncode, report, tail)
+    return out
+
+
+def _pod_specs(tmp_path, nproc, local_devices, **extra):
+    port = _free_port()
+    return [dict(rank=r, num_hosts=nproc, port=port,
+                 local_devices=local_devices,
+                 out=str(tmp_path / f"r{r}.json"), **extra)
+            for r in range(nproc)]
+
+
+def _single_host_reference(mode):
+    """The 1-process x 8-device model (conftest provides the devices); the
+    same deterministic problem/params the workers train — f64 accounting so
+    reduction order cannot leak into the text."""
+    rng = np.random.RandomState(0)
+    X = rng.randn(600, 30)
+    y = (X[:, 0] + np.sin(X[:, 1]) + 0.3 * rng.randn(600) > 0).astype(float)
+    params = {"objective": "binary", "num_leaves": 7, "max_bin": 31,
+              "min_data_in_leaf": 5, "verbosity": -1, "metric": "none",
+              "tree_learner": mode, "parallel_mesh": "2x4",
+              "tpu_hist_dtype": "float64", "tpu_double_precision": True}
+    bst = lgb.Booster(params, lgb.Dataset(X, label=y, params=params))
+    for _ in range(ITERS):
+        bst.update()
+    return bst.model_to_string()
+
+
+def test_two_host_pod_record_exact(tmp_path):
+    """2 processes x 4 devices == 1 process x 8 devices, byte for byte, for
+    both sharded tree_learner modes — and the warmed multi-host step never
+    retraces (recompile sentinel armed inside each worker)."""
+    specs = _pod_specs(tmp_path, nproc=2, local_devices=4, job="train",
+                       modes=list(MODES), mesh="2x4", iters=ITERS)
+    pod = _run_pod(specs, timeout_s=540)
+    for rank, (rc, report, tail) in pod.items():
+        assert rc == 0 and report is not None, \
+            f"rank {rank} failed (rc={rc}):\n{tail[-3000:]}"
+        assert report["process_count"] == 2
+        assert report["device_count"] == 8
+        assert report["local_device_count"] == 4
+    for mode in MODES:
+        ref = _single_host_reference(mode)
+        expect_learner = {"data": "ShardedWaveLearner",
+                          "data_feature": "ShardedWave2DLearner"}[mode]
+        for rank, (_rc, report, tail) in pod.items():
+            got = report["modes"][mode]
+            assert got["learner"] == expect_learner, \
+                f"rank {rank} routed {mode} to {got['learner']}"
+            assert got["model"] == ref, \
+                f"rank {rank} {mode} model differs from single-host"
+            assert not got["retraces"], \
+                f"rank {rank} {mode} retraced warmed step: {got['retraces']}"
+            # one engine-loop heartbeat per boosting iteration
+            assert got["heartbeats"] == ITERS
+    # DistributedNet seam: allgather/sync over the coordinator KV store
+    for rank, (_rc, report, _tail) in pod.items():
+        net = report["net"]
+        assert net["allgather"] == [["hello", 0], ["hello", 1]]
+        assert net["sync_min"] == 100
+        assert net["sync_max"] == 101
+
+
+@pytest.mark.chaos(timeout=180)
+def test_host_crash_names_dead_rank(tmp_path):
+    """Kill one host process mid-collective (``net.crash`` chaos point
+    compiled into DistributedNet.allgather): the dead rank exits 17, and
+    EVERY survivor raises a ConnectionError naming rank 1 within the
+    collective deadline, with the reliability counters ticked."""
+    deadline = 8.0
+    specs = _pod_specs(tmp_path, nproc=3, local_devices=1, job="chaos",
+                       faults="net.crash:rank=1:nth=3", beats=6,
+                       deadline_s=deadline)
+    pod = _run_pod(specs, timeout_s=150)
+    rc1, report1, tail1 = pod[1]
+    assert rc1 == 17, f"crashed rank exited {rc1}, not 17:\n{tail1[-2000:]}"
+    assert report1 is None                      # died before writing
+    for rank in (0, 2):
+        rc, report, tail = pod[rank]
+        assert rc == 0 and report is not None, \
+            f"survivor {rank} failed (rc={rc}):\n{tail[-3000:]}"
+        err = report["survived_error"]
+        assert err, f"survivor {rank} never observed the crash"
+        assert "rank(s) 1" in err and "never posted" in err, err
+        assert "multihost collective #3" in err, err
+        # named within the deadline (+ slack for the per-key scan)
+        assert report["elapsed_s"] < 3 * deadline + 10
+        ctr = report["rel_counters"]
+        assert ctr.get("net.multihost_collective_timeouts", 0) >= 1
+        assert ctr.get("net.multihost_peers_dead", 0) >= 1
+
+
+# -- config resolution (in-process unit tests) ------------------------------
+
+class _Cfg:
+    def __init__(self, **kw):
+        self.coordinator_address = kw.get("coordinator_address", "")
+        self.num_hosts = kw.get("num_hosts", 1)
+        self.process_id = kw.get("process_id", -1)
+
+
+@pytest.fixture
+def no_mh_env(monkeypatch):
+    for k in (multihost.ENV_COORDINATOR, multihost.ENV_NUM_HOSTS,
+              multihost.ENV_PROCESS_ID):
+        monkeypatch.delenv(k, raising=False)
+
+
+def test_resolve_multihost_single_host_default(no_mh_env):
+    assert multihost.resolve_multihost(_Cfg()) is None
+    assert multihost.resolve_multihost(None) is None
+
+
+def test_resolve_multihost_full_spec(no_mh_env):
+    cfg = _Cfg(coordinator_address="10.0.0.1:1234", num_hosts=4,
+               process_id=2)
+    assert multihost.resolve_multihost(cfg) == ("10.0.0.1:1234", 4, 2)
+
+
+def test_resolve_multihost_env_fills_gaps(no_mh_env, monkeypatch):
+    monkeypatch.setenv(multihost.ENV_COORDINATOR, "h:1")
+    monkeypatch.setenv(multihost.ENV_NUM_HOSTS, "2")
+    monkeypatch.setenv(multihost.ENV_PROCESS_ID, "1")
+    assert multihost.resolve_multihost(_Cfg()) == ("h:1", 2, 1)
+
+
+def test_resolve_multihost_partial_spec_is_error(no_mh_env):
+    with pytest.raises(ValueError, match="under-specified"):
+        multihost.resolve_multihost(_Cfg(num_hosts=2))
+    with pytest.raises(ValueError, match="under-specified"):
+        multihost.resolve_multihost(
+            _Cfg(coordinator_address="h:1", num_hosts=2))
+
+
+def test_resolve_multihost_rank_out_of_range(no_mh_env):
+    with pytest.raises(ValueError, match="out of range"):
+        multihost.resolve_multihost(
+            _Cfg(coordinator_address="h:1", num_hosts=2, process_id=2))
+
+
+def test_distributed_net_requires_initialization(no_mh_env):
+    # this (single) test process never calls jax.distributed.initialize
+    with pytest.raises(RuntimeError, match="not initialized"):
+        multihost.DistributedNet(rank=0, num_machines=1, deadline_s=1.0)

@@ -1,0 +1,226 @@
+"""Out-of-core (``two_round``) streaming ingestion: golden parity with the
+in-memory loader.
+
+The contract under test (`dataset.py:_ConstructedDataset.from_stream`): the
+two-pass chunked loader produces BIT-IDENTICAL BinMappers, packed device
+words, metadata and trained model text vs loading the same file in memory —
+while never materializing the full float64 matrix (asserted with
+tracemalloc).  The mod-partition variant (``num_machines > 1``) must equal
+the mod-partition of the in-memory words row for row.
+"""
+
+import json
+import os
+import tracemalloc
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.dataset import _ConstructedDataset
+from lightgbm_tpu.io.parser import (iter_data_chunks, load_data_file,
+                                    scan_data_file)
+
+PARAMS = {"verbosity": -1, "max_bin": 63, "bin_construct_sample_cnt": 700,
+          "data_random_seed": 3, "stream_chunk_rows": 173}
+
+
+def _mappers_json(ds):
+    # NaN-laden bounds: == on dicts is false for NaN, json text is stable
+    return json.dumps([m.to_dict() for m in ds.bin_mappers])
+
+
+def _write_csv(path, X, y, *, nan_as_empty=True):
+    with open(path, "w") as fh:
+        for i in range(len(X)):
+            row = [repr(float(y[i]))]
+            for v in X[i]:
+                row.append("" if (nan_as_empty and np.isnan(v))
+                           else repr(float(v)))
+            fh.write(",".join(row) + "\n")
+
+
+@pytest.fixture
+def csv_file(tmp_path, rng):
+    n, f = 3000, 9
+    X = rng.randn(n, f)
+    X[:, 2] = rng.randint(0, 6, n).astype(float)    # low-cardinality ints
+    X[rng.rand(n, f) < 0.04] = np.nan               # missing incl. trailing
+    X[:, 5][rng.rand(n) < 0.5] = 0.0                # sparse zeros
+    y = (X[:, 0] + np.nan_to_num(X[:, 1]) > 0).astype(float)
+    p = tmp_path / "train.csv"
+    _write_csv(p, X, y)
+    return str(p), X, y
+
+
+def test_chunks_concat_equals_in_memory_parse(csv_file):
+    path, X, y = csv_file
+    mat, label, _w, _g = load_data_file(path, PARAMS)
+    info = scan_data_file(path, PARAMS)
+    assert (info.num_rows, info.num_features) == mat.shape[::-1][::-1] \
+        or (info.num_rows, info.num_features) == mat.shape
+    smat = np.concatenate([c[1] for c in
+                           iter_data_chunks(path, PARAMS, 173, info=info)])
+    slab = np.concatenate([c[2] for c in
+                           iter_data_chunks(path, PARAMS, 173, info=info)])
+    assert np.array_equal(smat, mat, equal_nan=True)
+    assert np.array_equal(slab, label, equal_nan=True)
+
+
+def test_streaming_dataset_bit_identical(csv_file):
+    path, _X, _y = csv_file
+    mem = lgb.Dataset(path, params=dict(PARAMS)).construct()._constructed
+    oc = lgb.Dataset(path, params=dict(
+        PARAMS, two_round=True)).construct()._constructed
+    assert _mappers_json(mem) == _mappers_json(oc)
+    assert np.array_equal(mem.used_feature_map, oc.used_feature_map)
+    assert mem.bins.dtype == oc.bins.dtype
+    assert np.array_equal(mem.bins, oc.bins)
+    assert np.array_equal(mem.metadata.label, oc.metadata.label)
+    assert mem.num_data == oc.num_data
+    assert mem.num_data_padded == oc.num_data_padded
+
+
+def test_streaming_dataset_bit_identical_categorical(csv_file):
+    path, _X, _y = csv_file
+    p = dict(PARAMS, categorical_feature="2")
+    mem = lgb.Dataset(path, params=p).construct()._constructed
+    oc = lgb.Dataset(path, params=dict(
+        p, two_round=True)).construct()._constructed
+    assert _mappers_json(mem) == _mappers_json(oc)
+    assert np.array_equal(mem.bins, oc.bins)
+
+
+def test_streaming_trained_model_byte_exact(csv_file):
+    path, _X, _y = csv_file
+    tp = dict(PARAMS, objective="binary", num_leaves=15, min_data_in_leaf=20,
+              metric="none")
+    boosters = []
+    for two_round in (False, True):
+        params = dict(tp, two_round=two_round)
+        bst = lgb.Booster(params, lgb.Dataset(path, params=params))
+        for _ in range(5):
+            bst.update()
+        boosters.append(bst)
+    assert boosters[0].model_to_string() == boosters[1].model_to_string()
+
+
+def test_streaming_mod_partition_matches_in_memory(csv_file):
+    """Sharded pass 2 (``global_row % num_machines == rank``) equals the
+    mod-partition of the in-memory words, with identical mappers on every
+    rank — the `io/distributed.py` CheckOrPartition contract."""
+    path, X, _y = csv_file
+    cfg = Config.from_params(PARAMS)
+    mem = lgb.Dataset(path, params=dict(PARAMS)).construct()._constructed
+    n = mem.num_data
+    M = 3
+    for r in range(M):
+        sh = _ConstructedDataset.from_stream(path, PARAMS, cfg,
+                                             rank=r, num_machines=M)
+        owned = np.arange(r, n, M)
+        assert _mappers_json(sh) == _mappers_json(mem)
+        assert sh.num_data == len(owned)
+        assert np.array_equal(sh.bins[:, :len(owned)],
+                              mem.bins[:, :n][:, owned])
+        assert np.array_equal(sh.metadata.label, mem.metadata.label[owned])
+        assert np.array_equal(sh.global_rows, owned)
+        assert sh.num_data_global == n
+
+
+def test_streaming_pre_partition_keeps_all_rows(csv_file):
+    path, _X, _y = csv_file
+    cfg = Config.from_params(PARAMS)
+    mem = lgb.Dataset(path, params=dict(PARAMS)).construct()._constructed
+    sh = _ConstructedDataset.from_stream(path, PARAMS, cfg, rank=1,
+                                         num_machines=3, pre_partition=True)
+    assert sh.num_data == mem.num_data
+    assert np.array_equal(sh.bins, mem.bins)
+
+
+def test_streaming_sidecars_weight_and_query(tmp_path, rng):
+    n, f = 240, 5
+    X = rng.randn(n, f)
+    y = (X[:, 0] > 0).astype(float)
+    path = str(tmp_path / "rank.csv")
+    _write_csv(path, X, y)
+    w = rng.rand(n)
+    sizes = np.full(24, 10, dtype=int)                 # 24 queries x 10 rows
+    np.savetxt(path + ".weight", w, fmt="%.9g")
+    np.savetxt(path + ".query", sizes, fmt="%d")
+    mem = lgb.Dataset(path, params=dict(PARAMS)).construct()._constructed
+    oc = lgb.Dataset(path, params=dict(
+        PARAMS, two_round=True)).construct()._constructed
+    assert np.array_equal(mem.metadata.weights, oc.metadata.weights)
+    assert np.array_equal(mem.metadata.query_boundaries,
+                          oc.metadata.query_boundaries)
+    # sharded: whole-query dealing (query q -> rank q % M), never torn rows
+    cfg = Config.from_params(PARAMS)
+    sh = _ConstructedDataset.from_stream(path, PARAMS, cfg, rank=1,
+                                         num_machines=2)
+    owned_q = np.arange(1, 24, 2)
+    owned_rows = np.concatenate([np.arange(q * 10, (q + 1) * 10)
+                                 for q in owned_q])
+    assert np.array_equal(sh.global_rows, owned_rows)
+    assert np.array_equal(np.diff(sh.metadata.query_boundaries),
+                          np.full(12, 10))
+    assert np.array_equal(sh.metadata.weights,
+                          oc.metadata.weights[owned_rows])
+    assert np.array_equal(sh.bins[:, :len(owned_rows)],
+                          mem.bins[:, :n][:, owned_rows])
+
+
+def test_streaming_libsvm_parity(tmp_path, rng):
+    n, f = 500, 7
+    X = (rng.rand(n, f) * 4).round(3)
+    X[rng.rand(n, f) < 0.6] = 0.0                      # sparse
+    y = rng.randint(0, 2, n)
+    path = str(tmp_path / "train.svm")
+    with open(path, "w") as fh:
+        for i in range(n):
+            toks = [str(int(y[i]))]
+            toks += [f"{j}:{float(X[i, j])!r}" for j in range(f) if X[i, j] != 0.0]
+            fh.write(" ".join(toks) + "\n")
+    mem = lgb.Dataset(path, params=dict(PARAMS)).construct()._constructed
+    oc = lgb.Dataset(path, params=dict(
+        PARAMS, two_round=True, stream_chunk_rows=64)).construct()._constructed
+    assert _mappers_json(mem) == _mappers_json(oc)
+    assert np.array_equal(mem.bins, oc.bins)
+    assert np.array_equal(mem.metadata.label, oc.metadata.label)
+
+
+def test_streaming_peak_memory_below_matrix_footprint(tmp_path, rng):
+    """Peak python-heap allocation of the streaming load stays well under
+    the full float64 matrix footprint — the whole point of two_round.  The
+    in-memory path holds n*f float64s (plus parse intermediates); streaming
+    holds one chunk + the bin-finding sample + the packed uint words."""
+    n, f = 20000, 40
+    X = rng.randn(n, f).round(6)
+    y = (X[:, 0] > 0).astype(float)
+    path = str(tmp_path / "big.csv")
+    _write_csv(path, X, y, nan_as_empty=False)
+    params = dict(PARAMS, two_round=True, stream_chunk_rows=512,
+                  bin_construct_sample_cnt=1000)
+    full_matrix_bytes = n * f * 8
+
+    tracemalloc.start()
+    ds = lgb.Dataset(path, params=params).construct()._constructed
+    _base, peak = tracemalloc.get_traced_memory()
+    tracemalloc.stop()
+    assert ds.num_data == n
+    assert peak < 0.5 * full_matrix_bytes, (
+        f"streaming peak {peak} bytes is not below half the full-matrix "
+        f"footprint {full_matrix_bytes}")
+    # and the binned words really are the in-memory ones
+    mem = lgb.Dataset(path, params=dict(
+        PARAMS, bin_construct_sample_cnt=1000)).construct()._constructed
+    assert np.array_equal(mem.bins, ds.bins)
+
+
+def test_scan_detects_format_and_shape(csv_file):
+    path, X, _y = csv_file
+    info = scan_data_file(path, PARAMS)
+    assert info.kind == "csv" and info.delim == ","
+    assert info.num_rows == len(X)
+    assert info.num_features == X.shape[1]
+    assert info.label_idx == 0
