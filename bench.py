@@ -45,6 +45,13 @@ Usage: ``python bench.py``          — both scales, one JSON line.
        once and ingest it through the streaming two-pass loader
        (``two_round=true``, `dataset.py:from_stream`) instead of from
        memory, so loader-path regressions show up in bench rounds.
+       ``--sync-every N``            — sampled-sync cadence
+       (``telemetry_sync_every``; defaults to 8 whenever telemetry is on):
+       every Nth iteration is bracketed with forced device syncs and the
+       per-leg runtime attribution table + rank-skew gauges are embedded
+       in the JSON line itself (``attribution`` / ``rank_skew`` keys), so
+       BENCH rounds carry the collective/phase attribution evidence
+       inline (observability/attribution.py).
 """
 
 import gc
@@ -150,9 +157,16 @@ def main():
     coordinator, argv = _pop_opt_arg(argv, "--coordinator")
     process_id, argv = _pop_opt_arg(argv, "--process-id")
     out_of_core, argv = _pop_flag(argv, "--out-of-core")
+    sync_every, argv = _pop_opt_arg(argv, "--sync-every")
     telem = telemetry_out is not None
     extra = {}
     mode_tag = ""
+    if telem:
+        # sampled-sync attribution on by default for telemetry benches:
+        # 1-in-8 iterations pays the sync, the rest stay pipelined
+        extra["telemetry_sync_every"] = int(sync_every) if sync_every else 8
+        if sync_every:
+            mode_tag += f", sync_every={sync_every}"
     if tree_learner:
         extra["tree_learner"] = tree_learner
         mode_tag = f", tree_learner={tree_learner}"
@@ -218,12 +232,31 @@ def main():
     if telem:
         from lightgbm_tpu.observability import validate_report
         for rep in reports.values():
+            assert "provenance" in rep, \
+                "telemetry report lost its provenance block (schema v7)"
             errs = validate_report(rep)
             assert not errs, errs
         with open(telemetry_out, "w") as fh:
             json.dump(reports, fh, indent=2, sort_keys=True)
             fh.write("\n")
         line["telemetry_out"] = telemetry_out
+        # the runtime attribution table + rank-skew gauges ride the
+        # driver-captured line itself (round-4 verdict: no perf evidence
+        # may live only in a side file)
+        attribution = {}
+        rank_skew = {}
+        for scale, rep in reports.items():
+            dist = rep.get("distributed", {})
+            if dist.get("attribution"):
+                attribution[scale] = dist["attribution"]
+            if dist.get("skew_ratio") is not None:
+                rank_skew[scale] = {
+                    "skew_ratio": dist["skew_ratio"],
+                    "slowest_rank": dist.get("slowest_rank")}
+        if attribution:
+            line["attribution"] = attribution
+        if rank_skew:
+            line["rank_skew"] = rank_skew
     print(json.dumps(line))
 
 

@@ -255,8 +255,12 @@ class GBDT:
                  objective: Optional[ObjectiveFunction] = None):
         self.cfg = cfg
         self.iter_ = 0
-        from ..observability import Telemetry
+        from ..observability import SampledSync, Telemetry
         self.telemetry = Telemetry(bool(getattr(cfg, "telemetry", False)))
+        # sampled-sync attribution bracket (observability/attribution.py):
+        # inert unless telemetry AND telemetry_sync_every > 0
+        self._sync_sampler = SampledSync(
+            self.telemetry, int(getattr(cfg, "telemetry_sync_every", 0)))
         self._pending: List[tuple] = []
         self._stopped = False
         self._model_version = 0          # bumped on in-place tree mutation
@@ -579,8 +583,11 @@ class GBDT:
         arrs = {n: getattr(obj, n) for n in self._OBJ_ARRAYS
                 if getattr(obj, n, None) is not None
                 and hasattr(getattr(obj, n), "shape")}
+        t0 = time.perf_counter()
         with self.telemetry.phase("gradients"):
-            return self._jit_grad_fn(self.train_score.score, arrs)
+            g, h = self._jit_grad_fn(self.train_score.score, arrs)
+        self._sync_sampler.leg("gradients", t0, (g, h))
+        return g, h
 
     # -- one boosting iteration (`gbdt.cpp:333-413`) -------------------------
 
@@ -600,6 +607,28 @@ class GBDT:
         """Returns True when training cannot continue (no splittable leaves)."""
         if not self.telemetry.enabled:
             return self._train_one_iter_inner(gradients, hessians)
+        ss = self._sync_sampler
+        if ss.sampled(self.iter_):
+            # sampled-sync bracket: drain the queued pipeline so the
+            # measured iteration holds only its own work, sync each leg
+            # (the ss.leg calls on the dispatch paths), then sync the
+            # whole iteration so ``sync.iteration`` is a true wall.  All
+            # ranks sample on the lockstep iteration counter, so the
+            # probe's collective is entered pod-wide together.
+            from ..observability import force_sync
+            ss.drain(self.train_score.score)
+            ss.active = True
+            t0 = time.perf_counter()
+            try:
+                with self.telemetry.phase("iteration"):
+                    ret = self._train_one_iter_inner(gradients, hessians)
+                    force_sync(self.train_score.score)
+            finally:
+                ss.active = False
+            self.telemetry.add_phase_time(
+                "sync.iteration", time.perf_counter() - t0, t0=t0)
+            ss.probe_exchange(self.learner)
+            return ret
         with self.telemetry.phase("iteration"):
             return self._train_one_iter_inner(gradients, hessians)
 
@@ -661,10 +690,14 @@ class GBDT:
             self._lr_dev = jnp.float32(self.shrinkage_rate)
             self._lr_dev_val = self.shrinkage_rate
         fmask = self._feature_sample()
+        _t0 = time.perf_counter()
         with tel.phase("tree_dispatch"):
             out = self._fused_iter_fn()(
                 self.train_score.score, self.learner.bins_packed(),
                 self._bag_mask, fmask, self._lr_dev)
+        # on sampled iterations the fused program IS the whole tree leg
+        # (gradients -> tree -> score update in one dispatch)
+        self._sync_sampler.leg("tree_build", _t0, out)
         score, rec_f, rec_i, rec_cat = out[:4]
         telem = out[4] if len(out) > 4 else None
         self.train_score.score = score
@@ -709,14 +742,21 @@ class GBDT:
             self._lr_dev_val = self.shrinkage_rate
         for k in range(self.num_tree_per_iteration):
             fmask = self._feature_sample()
+            _t0 = time.perf_counter()
             with tel.phase("tree_dispatch"):
                 rec_f, rec_i, rec_cat, leaf_id, leaf_out = \
                     self.learner.train_async(grad[k], hess[k],
                                              self._bag_mask, fmask)
+            self._sync_sampler.leg(
+                "tree_build", _t0, (rec_f, rec_i, rec_cat, leaf_id,
+                                    leaf_out))
+            _t0 = time.perf_counter()
             with tel.phase("score_update"):
                 self.train_score.score = _score_add_leaf(
                     self.train_score.score, leaf_out, leaf_id,
                     self._lr_dev, k)
+            self._sync_sampler.leg("score_update", _t0,
+                                   (self.train_score.score,))
             telem = self.learner.take_telemetry() \
                 if tel.enabled and hasattr(self.learner, "take_telemetry") \
                 else None
@@ -750,9 +790,14 @@ class GBDT:
             leaf_id = None
             if self.class_need_train[k] and self.train_data.num_used_features > 0:
                 fmask = self._feature_sample()
+                _t0 = time.perf_counter()
                 with tel.phase("tree_train"):
                     new_tree, leaf_id = self.learner.train(
                         grad[k], hess[k], self._bag_mask, fmask)
+                # on sampled iterations record tree_train as a sync leg:
+                # the host phase's global mean undercounts the sampled
+                # wall (iteration 0's compile is always sampled)
+                self._sync_sampler.leg("tree_train", _t0, (leaf_id,))
                 if tel.enabled and hasattr(self.learner, "take_telemetry"):
                     telem = self.learner.take_telemetry()
                     if telem is not None:
@@ -760,16 +805,23 @@ class GBDT:
                         tel.device_telem(telem)
             if new_tree.num_leaves > 1:
                 should_continue = True
-                if self.objective is not None:
-                    score_np = np.asarray(self.train_score.score[k])
-                    self.objective.renew_tree_output(
-                        new_tree, score_np[:self.num_data],
-                        leaf_id, self._np_bag())
-                new_tree.apply_shrinkage(self.shrinkage_rate)
-                self.train_score.add_by_leaf_id(
-                    new_tree.leaf_value[:new_tree.num_leaves], leaf_id, k)
-                for vs in self.valid_scores:
-                    vs.add_by_tree(new_tree, k)
+                # score_update here covers the whole post-tree host leg
+                # (output renewal + train AND valid score updates) so the
+                # attribution table's leg sum tracks the iteration wall on
+                # the non-pipelined path too
+                _t0 = time.perf_counter()
+                with tel.phase("score_update"):
+                    if self.objective is not None:
+                        score_np = np.asarray(self.train_score.score[k])
+                        self.objective.renew_tree_output(
+                            new_tree, score_np[:self.num_data],
+                            leaf_id, self._np_bag())
+                    new_tree.apply_shrinkage(self.shrinkage_rate)
+                    self.train_score.add_by_leaf_id(
+                        new_tree.leaf_value[:new_tree.num_leaves], leaf_id, k)
+                    for vs in self.valid_scores:
+                        vs.add_by_tree(new_tree, k)
+                self._sync_sampler.leg("score_update", _t0, ())
                 if abs(init_scores[k]) > kEpsilon:
                     new_tree.leaf_value[:new_tree.num_leaves] += init_scores[k]
                     new_tree.shrinkage = 1.0
@@ -910,6 +962,15 @@ class GBDT:
         if not light:
             self._flush_pending()
             tel.flush_device()
+        if tel.enabled:
+            tel.set_provenance(
+                tree_learner=str(self.cfg.tree_learner),
+                learner=(type(self.learner).__name__
+                         if self.learner is not None else None),
+                mesh_shape=(str(dict(self._mesh.shape))
+                            if self._mesh is not None else None))
+            if self._sync_sampler.every > 0:
+                tel.set_distributed(sync_every=self._sync_sampler.every)
         ledger = getattr(self.learner, "_ledger", None)
         gauges = {}
         if self.learner is not None and \

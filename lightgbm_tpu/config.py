@@ -134,6 +134,9 @@ ALIAS_TABLE: Dict[str, str] = {
     "stats_out": "serve_stats_out",
     "stats_interval": "serve_stats_interval",
     "trace_file": "trace_out",
+    "sync_every": "telemetry_sync_every",
+    "skew_warn_ratio": "telemetry_skew_warn_ratio",
+    "prom_out": "telemetry_prom_out",
 }
 
 _OBJECTIVE_ALIASES = {
@@ -451,6 +454,23 @@ class Config:
     # span ring-buffer capacity: a long-lived server overwrites its
     # oldest spans past this instead of growing without bound
     trace_capacity: int = 65536
+    # sampled-sync attribution (observability/attribution.py): every Nth
+    # iteration the boosting loop drains the dispatch queue and brackets
+    # each leg of the jitted step (gradients / tree build / score update
+    # / exchange probe) with a forced device sync, landing the per-leg
+    # "sync.*" phases the report's distributed.attribution table is built
+    # from.  0 (default) = never sync — the pipeline stays fully async.
+    # Requires telemetry; ignored otherwise
+    telemetry_sync_every: int = 0
+    # straggler detection on a multi-host pod: per-rank step timings ride
+    # the liveness heartbeat, and when max/median exceeds this ratio a
+    # warning names the slowest rank (gauges land regardless).  <= 0
+    # disables the warning
+    telemetry_skew_warn_ratio: float = 2.0
+    # write the lgbt_training_* Prometheus text exposition
+    # (observability/metrics_export.py training_prometheus) here when
+    # training finishes — the scrape-file analogue of telemetry_out
+    telemetry_prom_out: str = ""
     # dev/test knob: override the batched replay correction's vectorized
     # span cap (_VEC_CAP, default 2^17 rows).  Tests shrink it so the
     # replicated span gate is exercised at CI problem sizes

@@ -23,6 +23,7 @@ Metadata (labels / weights / query boundaries / init scores) mirrors
 from __future__ import annotations
 
 import math
+import time
 from typing import Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
@@ -570,9 +571,18 @@ class _ConstructedDataset:
         self.config = cfg
         chunk_rows = max(int(cfg.stream_chunk_rows), 1)
 
+        # ingestion-chunk spans on the pod flight recorder: the engine
+        # registers its TraceRecorder globally BEFORE dataset construction
+        # (streaming happens inside Booster.__init__, before any telemetry
+        # object exists), so every host's trace shows where its load time
+        # went chunk by chunk.  None when tracing is off — zero overhead.
+        from .observability.trace import get_global_tracer
+        tracer = get_global_tracer()
+
         # ---- pass 1: the from_matrix sample, collected chunk-wise
         sample_idx = self._sample_indices(n, cfg)
         parts: List[np.ndarray] = []
+        _t0 = time.perf_counter() if tracer is not None else 0.0
         for start, mat, _lab in iter_data_chunks(path, params, chunk_rows,
                                                  info=info):
             if sample_idx is None:
@@ -582,6 +592,12 @@ class _ConstructedDataset:
                 hi = np.searchsorted(sample_idx, start + len(mat))
                 if hi > lo:
                     parts.append(mat[sample_idx[lo:hi] - start])
+            if tracer is not None:
+                tracer.add_complete(
+                    "ingest.sample_chunk", _t0,
+                    time.perf_counter() - _t0, cat="ingest",
+                    args={"start": int(start), "rows": int(len(mat))})
+                _t0 = time.perf_counter()
         sample = np.concatenate(parts, axis=0) if parts \
             else np.zeros((0, f), dtype=np.float64)
         parts = None
@@ -616,6 +632,7 @@ class _ConstructedDataset:
         self.bins = np.zeros((fu_pad, self.num_data_padded), dtype=dtype)
         labels = np.zeros(n_local, dtype=np.float64)
         dst = 0
+        _t0 = time.perf_counter() if tracer is not None else 0.0
         for start, mat, lab in iter_data_chunks(path, params, chunk_rows,
                                                 info=info):
             lo = np.searchsorted(owned, start)
@@ -630,6 +647,12 @@ class _ConstructedDataset:
                     m.values_to_bins(sub[:, j]).astype(dtype)
             labels[dst:dst + len(rows)] = lab[rows]
             dst += len(rows)
+            if tracer is not None:
+                tracer.add_complete(
+                    "ingest.bin_chunk", _t0,
+                    time.perf_counter() - _t0, cat="ingest",
+                    args={"start": int(start), "owned": int(len(rows))})
+                _t0 = time.perf_counter()
         if dst != n_local:
             raise ValueError(f"stream produced {dst} owned rows, "
                              f"expected {n_local} — file changed mid-load?")

@@ -42,6 +42,7 @@ class Booster:
         # device touch (dataset construct uploads arrays); the per-iteration
         # liveness heartbeat rides the same coordinator (parallel/multihost)
         self._mh_net = None
+        self._last_step_s: Optional[float] = None
         from .parallel import multihost
         if multihost.initialize_from_config(self.cfg) and train_set is not None:
             self._mh_net = multihost.net_for_run(self.cfg)
@@ -59,6 +60,10 @@ class Booster:
             # binning happened before the GBDT (and its Telemetry) existed
             # — credit it to the report's "binning" phase after the fact
             self.gbdt.telemetry.add_phase_time("binning", _bin_s)
+            if self._mh_net is not None:
+                self.gbdt.telemetry.set_distributed(
+                    process_count=int(self._mh_net.num_machines),
+                    process_index=int(self._mh_net.rank))
         elif model_file is not None:
             with open(model_file) as fh:
                 self._load_from_string(fh.read())
@@ -92,16 +97,67 @@ class Booster:
                fobj: Optional[Callable] = None) -> bool:
         """One boosting iteration (`basic.py:1842`); returns True if training
         should stop."""
+        tel = self.gbdt.telemetry
         if self._mh_net is not None:
             # pre-step liveness agreement: a host that died since the last
             # iteration surfaces HERE as a ConnectionError naming the dead
             # rank (within the collective deadline) instead of a hang
-            # inside the next XLA collective
-            self._mh_net.heartbeat(self.gbdt.iter_)
+            # inside the next XLA collective.  With telemetry on, the LAST
+            # step's host duration rides the same allgather — straggler
+            # detection without an extra collective
+            payload = self._last_step_s if tel.enabled else None
+            with tel.phase("heartbeat"):
+                peers = self._mh_net.heartbeat(self.gbdt.iter_,
+                                               payload=payload)
+            if tel.enabled:
+                self._note_rank_skew(peers)
+        if not tel.enabled:
+            if fobj is None:
+                return self.gbdt.train_one_iter()
+            grad, hess = fobj(self._curr_preds(), self._train_set)
+            return self.__boost(grad, hess)
+        import time as _time
+        _t0 = _time.perf_counter()
         if fobj is None:
-            return self.gbdt.train_one_iter()
-        grad, hess = fobj(self._curr_preds(), self._train_set)
-        return self.__boost(grad, hess)
+            ret = self.gbdt.train_one_iter()
+        else:
+            grad, hess = fobj(self._curr_preds(), self._train_set)
+            ret = self.__boost(grad, hess)
+        self._last_step_s = _time.perf_counter() - _t0
+        return ret
+
+    def _note_rank_skew(self, peers) -> None:
+        """Land rank-skew gauges from the heartbeat's gathered step
+        timings; past ``telemetry_skew_warn_ratio`` emit a warning NAMING
+        the slowest rank."""
+        tel = self.gbdt.telemetry
+        times: Dict[int, Optional[float]] = {}
+        for p in peers or ():
+            if isinstance(p, tuple) and len(p) >= 4 and p[0] == "hb":
+                times[int(p[1])] = None if p[3] is None else float(p[3])
+        vals = sorted(s for s in times.values() if s is not None)
+        if not vals:
+            return
+        tel.set_distributed(rank_step_s={str(r): s for r, s
+                                         in sorted(times.items())})
+        if len(vals) < 2:
+            return
+        m = len(vals)
+        med = vals[m // 2] if m % 2 else \
+            0.5 * (vals[m // 2 - 1] + vals[m // 2])
+        slow_s, slow_rank = max(
+            (s, r) for r, s in times.items() if s is not None)
+        ratio = (slow_s / med) if med > 0 else 0.0
+        warn_ratio = float(getattr(self.cfg,
+                                   "telemetry_skew_warn_ratio", 0.0))
+        tel.set_distributed(skew_ratio=ratio, slowest_rank=int(slow_rank),
+                            skew_warn_ratio=warn_ratio)
+        if warn_ratio > 0 and ratio > warn_ratio:
+            tel.inc("straggler_warnings")
+            warnings.warn(
+                f"straggler: rank {slow_rank} last step "
+                f"{slow_s * 1e3:.1f} ms is {ratio:.2f}x the pod median "
+                f"({med * 1e3:.1f} ms)")
 
     def __boost(self, grad: np.ndarray, hess: np.ndarray) -> bool:
         return self.gbdt.train_one_iter(grad, hess)
@@ -367,14 +423,19 @@ def train(params: Dict, train_set: Dataset, num_boost_round: int = 100,
     if categorical_feature != "auto":
         train_set.categorical_feature = categorical_feature
 
-    booster = Booster(params=params, train_set=train_set)
     # structured span recorder (observability/trace.py): host-side only —
     # attaching it cannot change a traced program, and with trace_out
-    # unset nothing is allocated
+    # unset nothing is allocated.  Created BEFORE the Booster (and
+    # registered process-wide) so the streaming loader's ingestion-chunk
+    # spans — recorded during dataset construction, before the GBDT's
+    # Telemetry exists — land in the same flight recorder
     _tracer = None
     if cfg_probe.trace_out:
-        from .observability.trace import TraceRecorder
+        from .observability.trace import TraceRecorder, set_global_tracer
         _tracer = TraceRecorder(True, capacity=cfg_probe.trace_capacity)
+        set_global_tracer(_tracer)
+    booster = Booster(params=params, train_set=train_set)
+    if _tracer is not None:
         booster.gbdt.telemetry.tracer = _tracer
     if init_booster is not None:
         _continue_training(booster, init_booster)
@@ -486,18 +547,54 @@ def train(params: Dict, train_set: Dataset, num_boost_round: int = 100,
     if booster.best_iteration <= 0:
         for name, mname, val, _ in (evaluation_result_list or []):
             booster.best_score.setdefault(name, {})[mname] = val
+    if _tracing and cfg_probe.telemetry:
+        # automated capture-and-parse: map the profiler's device events
+        # back to the named legs and the ledger's collective sites; lands
+        # in the report's distributed.profile (None when the backend
+        # wrote no Chrome-format trace — xplane-only captures)
+        from .observability.attribution import attribute_profile
+        prof = attribute_profile(
+            cfg_probe.profile_trace_dir,
+            getattr(booster.gbdt.learner, "_ledger", None))
+        if prof is not None:
+            booster.gbdt.telemetry.set_distributed(profile=prof)
+    if booster._mh_net is not None and cfg_probe.telemetry \
+            and (cfg_probe.telemetry_out or cfg_probe.trace_out):
+        # one clock-offset handshake serves both the report's
+        # distributed.clock and the per-rank trace metadata below
+        from .observability import podtrace as _podtrace
+        _clk = _podtrace.estimate_clock_offset(booster._mh_net)
+        booster.gbdt.telemetry.set_distributed(clock={
+            "offset_us": _clk["offset_s"] * 1e6,
+            "rtt_us": _clk["rtt_s"] * 1e6,
+            "rounds": _clk["rounds"], "method": _clk["method"]})
+    else:
+        _clk = None
     if cfg_probe.telemetry and cfg_probe.telemetry_out:
         from .observability import write_report
         write_report(booster.get_telemetry(), cfg_probe.telemetry_out)
+    if cfg_probe.telemetry and cfg_probe.telemetry_prom_out:
+        from .observability.metrics_export import training_prometheus
+        import os
+        _prom_tmp = cfg_probe.telemetry_prom_out + ".tmp"
+        with open(_prom_tmp, "w") as _fh:
+            _fh.write(training_prometheus(booster.get_telemetry()))
+        os.replace(_prom_tmp, cfg_probe.telemetry_prom_out)
     if _tracer is not None:
         # annotate the span timeline with the collective ledger's static
-        # sites (op/phase/cadence/bytes), then write the Chrome JSON
+        # sites (op/phase/cadence/bytes), then write the Chrome JSON —
+        # per-rank (`<trace_out>.rank<r>`) on a pod, with the clock
+        # handshake stamped into otherData for podtrace.merge_pod_trace
         ledger = getattr(booster.gbdt.learner, "_ledger", None)
         if ledger is not None:
             for site in ledger.sites():
                 _tracer.instant(f"collective:{site['op']}",
                                 cat="collective", args=dict(site))
-        _tracer.save(cfg_probe.trace_out)
+        from .observability import podtrace as _podtrace
+        from .observability.trace import set_global_tracer
+        _podtrace.export_rank_trace(_tracer, cfg_probe.trace_out,
+                                    net=booster._mh_net, clock=_clk)
+        set_global_tracer(None)
     return booster
 
 

@@ -220,6 +220,13 @@ class TPUTreeLearner:
         t, self._last_telem = self._last_telem, None
         return t
 
+    def exchange_probe(self):
+        """Standalone jitted program over this learner's cross-device
+        exchange seam, as ``(fn, args)`` for the sampled-sync attribution
+        probe (`observability/attribution.py`), or None when the learner
+        has no exchange (the serial paths)."""
+        return None
+
     # -- device functions ----------------------------------------------------
 
     def _hist(self, w):

@@ -38,20 +38,47 @@ boosting loop and tree learners report through:
     raw-sample window, and the text-format snapshot behind the server's
     ``metrics`` op.  Schema v4 adds the serving ``latency_ms`` section.
 
+Schema v7 adds the distributed-training layer (ROADMAP items 1 & 2):
+
+  * ``attribution`` (`attribution.py`) — the sampled-sync timer
+    (``telemetry_sync_every``: every Nth iteration brackets each leg of
+    the jitted step with a forced sync), the exchange-window probe the
+    sharded learners expose, the per-leg attribution table, and the
+    best-effort ``jax.profiler`` Chrome-trace parse.  One timing
+    implementation (``timeit``/``force_sync``) shared with the
+    ``profiling/`` scripts.
+  * ``podtrace`` (`podtrace.py`) — the pod flight recorder: per-rank
+    trace export with a KV-store clock-offset handshake, and the merge
+    of N per-rank traces into ONE pod-wide Chrome trace.
+  * every report carries a required ``provenance`` block (platform /
+    jax version / host count / emulated-vs-real) and a ``distributed``
+    section (rank skew, attribution table, memory watermarks);
+    ``training_prometheus`` renders it as ``lgbt_training_*`` gauges.
+
 Device-side *time* attribution inside the fused tree program is out of
 scope for counters — that is what the opt-in ``profile_trace_dir``
 (`jax.profiler`) trace is for; see README "Telemetry & profiling" and
 "Tracing & service metrics".
 """
 
+from .attribution import (SampledSync, attribution_table, force_sync,
+                          parse_profiler_trace, timeit)
 from .collectives import CollectiveLedger
 from .metrics_export import (BENCH_SERVING_SCHEMA, LatencyHistogram,
-                             prometheus_text)
+                             prometheus_text, training_prometheus)
+from .podtrace import estimate_clock_offset, export_rank_trace, \
+    merge_pod_trace
 from .report import load_schema, validate_report, write_report
-from .telemetry import TEL_NAMES, Telemetry
-from .trace import TraceRecorder, new_trace_id
+from .telemetry import TEL_NAMES, Telemetry, provenance_section
+from .trace import (TraceRecorder, get_global_tracer, new_trace_id,
+                    set_global_tracer)
 
 __all__ = ["Telemetry", "CollectiveLedger", "TEL_NAMES",
            "load_schema", "validate_report", "write_report",
            "TraceRecorder", "new_trace_id", "LatencyHistogram",
-           "prometheus_text", "BENCH_SERVING_SCHEMA"]
+           "prometheus_text", "BENCH_SERVING_SCHEMA",
+           "SampledSync", "attribution_table", "force_sync",
+           "parse_profiler_trace", "timeit", "training_prometheus",
+           "estimate_clock_offset", "export_rank_trace",
+           "merge_pod_trace", "provenance_section",
+           "get_global_tracer", "set_global_tracer"]

@@ -15,6 +15,7 @@ decoded per-tree wave/stall counters to estimate dynamic per-tree totals.
 
 from __future__ import annotations
 
+import contextlib
 from typing import Any, Dict, Iterable, List
 
 
@@ -58,6 +59,20 @@ class CollectiveLedger:
         self._keys.add(key)
         self._sites.append({"op": op, "phase": phase, "cadence": cadence,
                             "bytes_per_call": b})
+
+    @contextlib.contextmanager
+    def muted(self):
+        """Suppress site recording while a SIDE program traces: the
+        attribution exchange probe (`attribution.py`) jits the learner's
+        real exchange seam standalone — its trace must not add sites, or
+        ``collectives.sites`` and the analysis-gate budgets would drift
+        from the actual tree programs."""
+        prev = self.enabled
+        self.enabled = False
+        try:
+            yield self
+        finally:
+            self.enabled = prev
 
     def sites(self) -> Iterable[Dict[str, Any]]:
         return list(self._sites)

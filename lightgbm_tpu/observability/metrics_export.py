@@ -206,6 +206,51 @@ def prometheus_snapshot(stats, registry=None, admission=None,
         histograms={"serving_request_latency_seconds": stats.request_hist})
 
 
+def training_prometheus(report: Dict[str, Any]) -> str:
+    """The TRAINING analogue of ``prometheus_snapshot``: render a
+    telemetry report (``Telemetry.report()`` / ``Booster.get_telemetry``)
+    as ``lgbt_training_*`` text exposition — phase totals, iteration
+    timings, device counters, rank-skew gauges and memory watermarks, so
+    a pod run scrapes the same way the serving fleet does."""
+    counters: Dict[str, float] = {
+        "training_iterations_total": report["iterations"]["count"],
+    }
+    for name, v in (report.get("counters") or {}).items():
+        counters[f"training_{sanitize_metric_name(name)}_total"] = v
+    gauges: Dict[str, float] = {
+        "training_iteration_mean_ms": report["iterations"]["mean_ms"],
+        "training_iteration_last_ms": report["iterations"]["last_ms"],
+    }
+    for phase, st in (report.get("phases") or {}).items():
+        g = sanitize_metric_name(phase)
+        gauges[f"training_phase_{g}_total_seconds"] = st["total_ms"] / 1e3
+        counters[f"training_phase_{g}_count_total"] = st["count"]
+    for name, v in (report.get("gauges") or {}).items():
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            gauges[f"training_{sanitize_metric_name(name)}"] = v
+    dist = report.get("distributed") or {}
+    if dist.get("skew_ratio") is not None:
+        gauges["training_rank_skew_ratio"] = dist["skew_ratio"]
+    if dist.get("slowest_rank") is not None:
+        gauges["training_slowest_rank"] = dist["slowest_rank"]
+    for r, s in (dist.get("rank_step_s") or {}).items():
+        if s is not None:
+            gauges[f"training_rank_step_seconds:{sanitize_metric_name(str(r))}"] = s
+    mem = dist.get("memory") or {}
+    for d in mem.get("devices") or ():
+        dev = sanitize_metric_name(d["device"])
+        gauges[f"training_hbm_peak_bytes:{dev}"] = d["peak_bytes_in_use"]
+    if mem.get("host_heap"):
+        gauges["training_host_heap_peak_bytes"] = \
+            mem["host_heap"]["peak_bytes"]
+    table = dist.get("attribution") or {}
+    for leg, ms in (table.get("legs_ms") or {}).items():
+        gauges[f"training_leg_ms:{sanitize_metric_name(leg)}"] = ms
+    if table.get("coverage") is not None:
+        gauges["training_attribution_coverage"] = table["coverage"]
+    return prometheus_text(counters, gauges)
+
+
 # -- bench_serving.py contract ------------------------------------------------
 
 _LATENCY_MS_SCHEMA = {

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import contextlib
 import time
+import tracemalloc
 from typing import Any, Dict, List, Optional
 
 import numpy as np
@@ -55,7 +56,72 @@ TEL_NAMES = {
 # v6: serving section gains optional "replicas" array (per-replica fleet
 # state: health, in-flight, dispatched, ejections, latency histogram —
 # `lightgbm_tpu/serving/fleet/replicas.py`)
-SCHEMA_VERSION = 6
+# v7: required "provenance" block (platform / jax version / device & host
+# counts / emulated-vs-real flag — no more BENCH_r06-style ambiguity about
+# what hardware a number came from) and optional "distributed" section
+# (per-rank step timings + skew, sampled-sync attribution table, memory
+# watermarks, clock-offset handshake — `observability/attribution.py` /
+# `observability/podtrace.py`)
+SCHEMA_VERSION = 7
+
+
+def provenance_section(extra: Optional[Dict[str, Any]] = None
+                       ) -> Dict[str, Any]:
+    """The required schema-v7 ``provenance`` block: what hardware and
+    software stack produced this report.  ``emulated`` is True whenever
+    the accelerator platform is NOT a real TPU (CPU runs, forced-host
+    virtual device pods) — the flag the BENCH/MULTICHIP writers assert on
+    so a CPU-parity number can never masquerade as a device result."""
+    out: Dict[str, Any] = {
+        "platform": "unknown", "device_kind": "unknown",
+        "jax_version": "unknown", "num_devices": 0, "num_hosts": 1,
+        "process_index": 0, "emulated": True, "mesh_shape": None,
+    }
+    try:
+        import jax
+        out["jax_version"] = str(jax.__version__)
+        devs = jax.devices()
+        out["platform"] = str(devs[0].platform)
+        out["device_kind"] = str(getattr(devs[0], "device_kind",
+                                         devs[0].platform))
+        out["num_devices"] = int(jax.device_count())
+        out["num_hosts"] = int(jax.process_count())
+        out["process_index"] = int(jax.process_index())
+        out["emulated"] = out["platform"] != "tpu"
+    except Exception:
+        pass
+    if extra:
+        out.update({k: v for k, v in extra.items() if v is not None})
+    return out
+
+
+def memory_watermarks() -> Dict[str, Any]:
+    """Device HBM peaks (``memory_stats()``; absent on backends that
+    don't expose them — CPU) and the process tracemalloc snapshot when
+    the caller has tracing on.  Host-only, never forces a device sync."""
+    devices = []
+    try:
+        import jax
+        for d in jax.local_devices():
+            try:
+                st = d.memory_stats()
+            except Exception:
+                st = None
+            if not st:
+                continue
+            devices.append({
+                "device": str(d),
+                "peak_bytes_in_use": int(st.get("peak_bytes_in_use", 0)),
+                "bytes_in_use": int(st.get("bytes_in_use", 0)),
+                "bytes_limit": int(st.get("bytes_limit", 0)),
+            })
+    except Exception:
+        pass
+    host = None
+    if tracemalloc.is_tracing():
+        cur, peak = tracemalloc.get_traced_memory()
+        host = {"current_bytes": int(cur), "peak_bytes": int(peak)}
+    return {"devices": devices, "host_heap": host}
 
 
 class Telemetry:
@@ -78,6 +144,13 @@ class Telemetry:
         self._device_totals = np.zeros(TEL_NSLOTS, np.int64)
         self._device_trees = 0
         self._last_tree: Optional[np.ndarray] = None
+        # schema-v7 additions: provenance extras (mesh shape, learner name
+        # — facts only the engine/GBDT knows), the distributed section
+        # (rank skew, clock handshake) and per-phase tracemalloc peaks
+        self._provenance_extra: Dict[str, Any] = {}
+        self._distributed: Dict[str, Any] = {}
+        self._phase_heap: Dict[str, int] = {}      # name -> peak bytes
+        self._heap_stack: List[int] = []
 
     # -- phases --------------------------------------------------------------
 
@@ -111,6 +184,36 @@ class Telemetry:
             if len(self._iter_wall) > 512:
                 del self._iter_wall[:256]
 
+    # -- host-heap watermarks (per phase) ------------------------------------
+    # tracemalloc's peak is global-since-start; per-phase window peaks use
+    # reset_peak() with explicit propagation to the enclosing phase, so a
+    # nested phase's reset never loses the parent's window high-water mark.
+    # Only active when the USER already turned tracemalloc on — telemetry
+    # never starts tracing itself (it costs ~2x on every allocation).
+
+    def _heap_enter(self) -> None:
+        if not tracemalloc.is_tracing():
+            return
+        try:
+            tracemalloc.reset_peak()
+        except Exception:   # pragma: no cover — <3.9 has no reset_peak
+            return
+        self._heap_stack.append(0)
+
+    def _heap_exit(self, name: str) -> None:
+        if not self._heap_stack or not tracemalloc.is_tracing():
+            return
+        try:
+            wpeak = max(tracemalloc.get_traced_memory()[1],
+                        self._heap_stack.pop())
+            self._phase_heap[name] = max(self._phase_heap.get(name, 0),
+                                         int(wpeak))
+            if self._heap_stack:
+                self._heap_stack[-1] = max(self._heap_stack[-1], wpeak)
+            tracemalloc.reset_peak()
+        except Exception:   # pragma: no cover
+            pass
+
     # -- counters / gauges ---------------------------------------------------
 
     def inc(self, name: str, v: int = 1) -> None:
@@ -120,6 +223,25 @@ class Telemetry:
     def gauge(self, name: str, v: Any) -> None:
         if self.enabled:
             self._gauges[name] = v
+
+    # -- distributed / provenance extras -------------------------------------
+
+    def set_provenance(self, **kw: Any) -> None:
+        """Merge engine/GBDT-known facts (mesh_shape, tree_learner, ...)
+        into the report's ``provenance`` block."""
+        if self.enabled:
+            self._provenance_extra.update(kw)
+
+    def set_distributed(self, **kw: Any) -> None:
+        """Merge pod facts (rank step timings, skew, clock handshake) into
+        the report's ``distributed`` section."""
+        if self.enabled:
+            self._distributed.update(kw)
+
+    def last_iteration_s(self) -> Optional[float]:
+        """Duration of the most recent "iteration" phase occurrence — the
+        per-rank step timing that rides the liveness heartbeat."""
+        return self._iter_wall[-1] if self._iter_wall else None
 
     # -- device counter lane -------------------------------------------------
 
@@ -184,7 +306,26 @@ class Telemetry:
         return {"schema_version": SCHEMA_VERSION, "enabled": self.enabled,
                 "phases": phases, "iterations": it, "counters": counters,
                 "gauges": gauges, "collectives": coll,
+                "provenance": provenance_section(self._provenance_extra),
+                "distributed": self._distributed_section(phases),
                 "reliability": reliability_section()}
+
+    def _distributed_section(self, phases_ms: Dict[str, Any]
+                             ) -> Dict[str, Any]:
+        """Schema-v7 ``distributed`` section: rank skew + clock handshake
+        (set by the engine via :meth:`set_distributed`), the sampled-sync
+        attribution table derived from the ``sync.*`` phases, and memory
+        watermarks."""
+        out: Dict[str, Any] = dict(self._distributed)
+        from .attribution import attribution_table
+        table = attribution_table(phases_ms)
+        if table is not None:
+            out["attribution"] = table
+        mem = memory_watermarks()
+        if self._phase_heap:
+            mem["phase_heap_peak_bytes"] = dict(self._phase_heap)
+        out["memory"] = mem
+        return out
 
     def _collectives(self, ledger, dev: Dict[str, int]) -> Dict[str, Any]:
         sites = list(ledger.sites()) if ledger is not None else []
@@ -226,10 +367,12 @@ class _PhaseCtx:
         self.name = name
 
     def __enter__(self):
+        self.tel._heap_enter()
         self.t0 = time.perf_counter()
         return self
 
     def __exit__(self, *exc):
         self.tel.add_phase_time(self.name, time.perf_counter() - self.t0,
                                 t0=self.t0)
+        self.tel._heap_exit(self.name)
         return False

@@ -59,6 +59,27 @@ def new_trace_id() -> str:
     return os.urandom(8).hex()
 
 
+# -- process-global recorder registry ----------------------------------------
+# Dataset construction (streaming ingestion chunks) happens before the
+# training GBDT — and therefore its Telemetry — exists, so those early
+# spans reach the flight recorder through this registration point instead
+# of an attribute path.  One training run per process is the norm; the
+# engine re-registers per run and clears on exit.
+
+_global_tracer: Optional["TraceRecorder"] = None
+
+
+def set_global_tracer(tracer: Optional["TraceRecorder"]) -> None:
+    """Register (or clear, with ``None``) the process-wide recorder."""
+    global _global_tracer
+    _global_tracer = tracer
+
+
+def get_global_tracer() -> Optional["TraceRecorder"]:
+    """The registered recorder, or None — callers must null-check."""
+    return _global_tracer
+
+
 class TraceRecorder:
     """Thread-safe ring buffer of completed spans + Chrome JSON export."""
 
@@ -73,6 +94,21 @@ class TraceRecorder:
         # perf_counter matches the clock Telemetry._PhaseCtx stamps t0
         # with, so phase spans and explicit spans share one timeline.
         self._epoch = time.perf_counter()
+        # free-form export metadata (rank, clock offsets, ...) merged into
+        # the exported ``otherData`` — the pod-trace merge reads it
+        self._metadata: Dict[str, Any] = {}
+
+    @property
+    def epoch(self) -> float:
+        """The perf_counter stamp every exported ts is relative to."""
+        return self._epoch
+
+    def set_metadata(self, **kw: Any) -> None:
+        """Attach export metadata (lands in ``otherData``).  Used by the
+        pod flight recorder: rank, process_count and the clock-offset
+        handshake results ride here so ``podtrace.merge_pod_trace`` can
+        put every rank's spans on one timeline."""
+        self._metadata.update(kw)
 
     # -- thread-local trace-id binding ---------------------------------------
 
@@ -187,11 +223,13 @@ class TraceRecorder:
         meta = [{"name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
                  "args": {"name": tname}}
                 for tid, tname in sorted(tid_names.items())]
+        other: Dict[str, Any] = {"dropped_spans": dropped,
+                                 "clock": "perf_counter",
+                                 "spans_recorded": self._total}
+        other.update(self._metadata)
         return {"traceEvents": meta + [e for _, e in events],
                 "displayTimeUnit": "ms",
-                "otherData": {"dropped_spans": dropped,
-                              "clock": "perf_counter",
-                              "spans_recorded": self._total}}
+                "otherData": other}
 
     def save(self, path: str) -> None:
         """Atomic (tmp + ``os.replace``) write of the exported trace."""

@@ -160,6 +160,25 @@ class FeatureShardedCompactLearner(ShardedCompactLearner):
             self._sharded_bins = self._rules().place("bins", packed)
         return self._sharded_bins
 
+    def exchange_probe(self):
+        """Feature-parallel's only per-split wire traffic is the tiny
+        best-split allgather (``SyncUpGlobalBestSplit``,
+        `_best_rows_global`) — probe exactly those three rows."""
+        if getattr(self, "_probe_fn", None) is None:
+            from ..learner_compact import NUM_CF, NUM_CI
+            ax = self.axis
+
+            def body(cf, ci, cb):
+                return (lax.all_gather(cf, ax), lax.all_gather(ci, ax),
+                        lax.all_gather(cb, ax))
+
+            return self._probe_program(
+                body, (P(), P(), P()), (P(), P(), P()),
+                (jnp.zeros((1, NUM_CF), self._acc),
+                 jnp.zeros((1, NUM_CI), jnp.int32),
+                 jnp.zeros((1, self.cat_W), jnp.uint32)))
+        return self._probe_fn, self._probe_args
+
 
 class FeatureShardedWaveLearner(FeatureShardedCompactLearner,
                                 WaveTPUTreeLearner):

@@ -249,13 +249,19 @@ class DistributedNet:
 
     # -- liveness ----------------------------------------------------------
 
-    def heartbeat(self, tag: int = 0) -> None:
+    def heartbeat(self, tag: int = 0, payload=None) -> List:
         """One tiny allgather: every live rank agrees everyone is still
         here, and a dead rank is NAMED within the collective deadline.  The
         boosting loop runs this before each iteration's jitted step
         (`engine.py`), so a host crash surfaces as a root-caused
-        ConnectionError instead of a hang inside an XLA collective."""
-        self.allgather(("hb", int(self.rank), int(tag)))
+        ConnectionError instead of a hang inside an XLA collective.
+
+        ``payload`` piggybacks per-rank observability data on the SAME
+        allgather (the engine passes its last step duration — straggler
+        detection costs zero extra collectives); the gathered
+        ``("hb", rank, tag, payload)`` tuples are returned so the caller
+        can compare ranks."""
+        return self.allgather(("hb", int(self.rank), int(tag), payload))
 
     def _missing_report(self, prefix: str):
         """(missing_ranks, message): which ranks never posted their payload

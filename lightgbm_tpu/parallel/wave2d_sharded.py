@@ -280,6 +280,19 @@ class ShardedWave2DLearner(ShardedWaveLearner):
         return self._pop_telem(self._jit_tree_w(
             self.sharded_bins(), grad, hess, bag, fmask_pad))
 
+    def exchange_probe(self):
+        """The 2D learner's dominant wire: the per-wave data-axis
+        reduce-scatter at the LOCAL feature-column shape, entered over
+        the full 2D mesh (the feature axis rides along replicated, as in
+        the real program)."""
+        if getattr(self, "_probe_fn", None) is None:
+            return self._probe_program(
+                lambda h: self._exchange(h, 1), P(),
+                P(None, self.axis),
+                (jnp.zeros((self.W, self.fs_col, self.num_bins_padded, 3),
+                           self._hist_dtype()),))
+        return self._probe_fn, self._probe_args
+
 
 def wave2d_ineligible_reason(cfg: Config, data: _ConstructedDataset,
                              mesh: Mesh) -> Optional[str]:

@@ -188,6 +188,18 @@ class ShardedWaveLearner(ShardedCompactLearner, WaveTPUTreeLearner):
         return self._jit_tree_w.lower(
             self.sharded_bins(), z, z, z, fmask_pad).compile().as_text()
 
+    def exchange_probe(self):
+        """The wave learner's real per-wave exchange: ONE batched
+        psum_scatter over the (W, f_pad, B, 3) member histograms,
+        scattered over the feature axis (`_wave_member_hists`)."""
+        if getattr(self, "_probe_fn", None) is None:
+            return self._probe_program(
+                lambda h: self._exchange(h, 1), P(),
+                P(None, self.axis),
+                (jnp.zeros((self.W, self.f_pad, self.num_bins_padded, 3),
+                           self._hist_dtype()),))
+        return self._probe_fn, self._probe_args
+
 
 class ShardedVotingWaveLearner(ShardedWaveLearner):
     """``tree_learner=voting`` on the frontier-wave learner: the histogram
@@ -228,6 +240,12 @@ class ShardedVotingWaveLearner(ShardedWaveLearner):
         from .compact_sharded import ShardedVotingLearner
         return ShardedVotingLearner._best_rows_global(
             self, hists, (sg, sh, cn), feature_mask, depth_ok, constraints)
+
+    def exchange_probe(self):
+        # voting's wire payload is the elected (2k-wide) feature set —
+        # probe that seam, not the full-width wave exchange
+        from .compact_sharded import ShardedVotingLearner
+        return ShardedVotingLearner.exchange_probe(self)
 
 
 def wave_sharded_eligible(cfg: Config, data: _ConstructedDataset,

@@ -17,23 +17,16 @@ profiling artifact) and prints a human table.
 import json
 import os
 import sys
-import time
 
 import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-
-def timeit(fn, *args, warmup=2, iters=5):
-    import jax
-    for _ in range(warmup):
-        r = fn(*args)
-    jax.block_until_ready(r)
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        r = fn(*args)
-    jax.block_until_ready(r)
-    return (time.perf_counter() - t0) / iters
+# ONE timing implementation repo-wide (PROFILE.md round-10 note): best-of
+# with a real device->host fetch per call, shared with the runtime
+# attribution probes — no hand-rolled block_until_ready loops here
+from lightgbm_tpu.observability.attribution import (  # noqa: E402
+    force_sync, timeit)
 
 
 def main():
@@ -59,7 +52,8 @@ def main():
     out = {"rows": rows, "device": str(jax.devices()[0])}
 
     # -- full iteration & tree ------------------------------------------------
-    t_iter = timeit(lambda: bst.update() or 0)
+    t_iter = timeit(lambda: bst.update() or 0,
+                    sync=lambda _: force_sync(bst.gbdt.train_score.score))
     out["full_iteration_s"] = t_iter
 
     lrn = bst.gbdt.learner

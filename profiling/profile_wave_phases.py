@@ -11,7 +11,6 @@ fetch) under monkeypatched variants:
 
 import os
 import sys
-import time
 
 import numpy as np
 
@@ -22,6 +21,8 @@ import jax.numpy as jnp  # noqa: E402
 
 import lightgbm_tpu as lgb  # noqa: E402
 from lightgbm_tpu.learner_wave import WaveTPUTreeLearner  # noqa: E402
+from lightgbm_tpu.observability.attribution import (  # noqa: E402
+    force_sync, timeit)
 
 
 def make(rows=1_000_000, W=None):
@@ -45,15 +46,11 @@ def make(rows=1_000_000, W=None):
 
 
 def timed_tree(learner, grad, hess, bag, iters=8):
-    out = learner.train_async(grad, hess, bag)
-    float(np.asarray(out[0][0, 0]))  # sync (block_until_ready is a no-op)
-    best = float("inf")
-    for _ in range(3):
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            out = learner.train_async(grad, hess, bag)
-        float(np.asarray(out[0][0, 0]))
-        best = min(best, (time.perf_counter() - t0) / iters)
+    # shared timing implementation (PROFILE.md round-10 note): best-of with
+    # a forced record fetch per call — block_until_ready alone is a no-op
+    # on the axon tunnel
+    best = timeit(learner.train_async, grad, hess, bag, iters=iters,
+                  warmup=1, sync=lambda out: force_sync(out[0]))
     return best * 1e3
 
 

@@ -18,6 +18,13 @@ The spec (one JSON argv) selects a job:
     (os._exit(17)) or a peer's death surfaces as the named-root-cause
     ConnectionError; survivors report the error text, elapsed time, and
     reliability counters.
+  * ``observe`` — pod observability drill: train through the engine
+    (``lgb.train``) with telemetry + the per-rank flight recorder
+    (``trace_out``), so every rank runs the clock-offset handshake and
+    exports ``<trace_out>.rank<r>``; when ``straggle_s`` is set, rank 1
+    sleeps inside every boosting step, so the heartbeat-borne skew gauges
+    must name it.  Reports the telemetry report's ``distributed`` +
+    ``provenance`` sections and counters.
 
 Results are written as JSON to ``spec["out"]``.
 """
@@ -118,6 +125,44 @@ def _job_train(spec):
     return out
 
 
+def _job_observe(spec):
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.boosting.gbdt import GBDT
+
+    if spec["rank"] == 1 and spec.get("straggle_s"):
+        # inject the straggler INSIDE the engine's step timing window
+        # (Booster.update brackets gbdt.train_one_iter), so the sleep
+        # rides the next heartbeat as this rank's step duration
+        delay = float(spec["straggle_s"])
+        orig = GBDT.train_one_iter
+
+        def slow(self, *a, **kw):
+            time.sleep(delay)
+            return orig(self, *a, **kw)
+
+        GBDT.train_one_iter = slow
+    X, y = _problem()
+    params = _pod_params(spec, spec.get("mode", "serial"))
+    params.update({
+        "telemetry": True,
+        "trace_out": spec["trace_out"],
+        "telemetry_out": spec["telemetry_out"],
+        "telemetry_sync_every": int(spec.get("sync_every", 0)),
+        "telemetry_skew_warn_ratio": float(spec.get("skew_warn_ratio", 0.0)),
+    })
+    ds = lgb.Dataset(X, label=y, params=params)
+    bst = lgb.train(params, ds,
+                    num_boost_round=int(spec.get("iters", 5)),
+                    verbose_eval=False, keep_training_booster=True)
+    with open(spec["telemetry_out"]) as fh:
+        rep = json.load(fh)
+    return {"rank": spec["rank"],
+            "learner": type(bst.gbdt.learner).__name__,
+            "distributed": rep.get("distributed"),
+            "provenance": rep.get("provenance"),
+            "counters": rep.get("counters")}
+
+
 def _job_chaos(spec):
     from lightgbm_tpu.config import Config
     from lightgbm_tpu.parallel import multihost
@@ -145,7 +190,8 @@ def _job_chaos(spec):
 def main():
     spec = json.loads(sys.argv[1])
     _setup(spec)
-    job = {"train": _job_train, "chaos": _job_chaos}[spec.get("job", "train")]
+    job = {"train": _job_train, "chaos": _job_chaos,
+           "observe": _job_observe}[spec.get("job", "train")]
     out = job(spec)
     with open(spec["out"], "w") as fh:
         json.dump(out, fh)

@@ -129,6 +129,85 @@ def test_two_host_pod_record_exact(tmp_path):
         assert net["sync_max"] == 101
 
 
+def _well_nested(events):
+    """Every (pid, tid) stream's B/E events must balance like brackets."""
+    stacks = {}
+    for ev in events:
+        ph = ev.get("ph")
+        if ph not in ("B", "E"):
+            continue
+        key = (ev["pid"], ev["tid"])
+        st = stacks.setdefault(key, [])
+        if ph == "B":
+            st.append(ev["name"])
+        else:
+            assert st, f"E without B on {key}: {ev['name']}"
+            top = st.pop()
+            assert top == ev["name"], \
+                f"mis-nested span on {key}: E {ev['name']} closes B {top}"
+    for key, st in stacks.items():
+        assert not st, f"unclosed spans on {key}: {st}"
+
+
+def test_two_host_pod_observability(tmp_path):
+    """The pod flight recorder end to end: both ranks export per-rank
+    traces with clock-handshake metadata, podtrace merges them into ONE
+    well-nested Chrome trace carrying BOTH ranks' iteration + heartbeat
+    spans, and an injected sleep on rank 1 trips the straggler gauges
+    naming rank 1 on every host."""
+    from lightgbm_tpu.observability.podtrace import merge_pod_trace
+
+    trace_base = str(tmp_path / "pod_trace.json")
+    specs = _pod_specs(tmp_path, nproc=2, local_devices=1, job="observe",
+                       modes=[], mesh="2x1", mode="serial", iters=5,
+                       sync_every=2, straggle_s=0.25, skew_warn_ratio=1.3,
+                       trace_out=trace_base)
+    for spec in specs:
+        spec["telemetry_out"] = str(tmp_path / f"telem_r{spec['rank']}.json")
+    pod = _run_pod(specs, timeout_s=540)
+    for rank, (rc, report, tail) in pod.items():
+        assert rc == 0 and report is not None, \
+            f"rank {rank} failed (rc={rc}):\n{tail[-3000:]}"
+        # provenance: the schema-v7 who-produced-this block
+        prov = report["provenance"]
+        assert prov["num_hosts"] == 2
+        assert prov["emulated"] is True          # CPU pod, never a TPU claim
+        dist = report["distributed"]
+        assert dist["process_count"] == 2
+        # clock handshake ran on every rank; rank 0 IS the reference
+        clk = dist["clock"]
+        assert clk["method"] == "kv-ping-midpoint"
+        if rank == 0:
+            assert clk["offset_us"] == 0.0
+        # straggler: the sleeping rank is named with a ratio past the bar
+        assert dist["slowest_rank"] == 1, dist
+        assert dist["skew_ratio"] > 1.3, dist
+        assert report["counters"].get("straggler_warnings", 0) >= 1
+        # per-rank step gauges carry BOTH ranks' timings
+        assert set(dist["rank_step_s"]) == {"0", "1"}
+        assert dist["rank_step_s"]["1"] > 0.25
+    # -- per-rank traces -> one pod-wide merge
+    paths = [f"{trace_base}.rank{r}" for r in (0, 1)]
+    for p in paths:
+        assert os.path.exists(p), f"missing per-rank trace {p}"
+    merged_path = str(tmp_path / "pod_merged.json")
+    merge_pod_trace(paths, out=merged_path)
+    with open(merged_path) as fh:
+        merged = json.load(fh)                   # valid Chrome trace JSON
+    events = merged["traceEvents"]
+    assert merged["otherData"]["pod_merge"] is True
+    assert merged["otherData"]["process_count"] == 2
+    for rank in (0, 1):
+        names = {ev["name"] for ev in events
+                 if ev.get("pid") == rank and ev.get("ph") == "B"}
+        assert "iteration" in names, f"rank {rank} lost iteration spans"
+        assert "heartbeat" in names, f"rank {rank} lost heartbeat spans"
+    _well_nested(events)
+    # timestamps are monotone post-merge modulo the B/E tie-break order
+    ts = [ev["ts"] for ev in events if ev.get("ph") in ("B", "E", "i")]
+    assert ts == sorted(ts)
+
+
 @pytest.mark.chaos(timeout=180)
 def test_host_crash_names_dead_rank(tmp_path):
     """Kill one host process mid-collective (``net.crash`` chaos point

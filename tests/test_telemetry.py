@@ -296,6 +296,112 @@ def test_stall_batch_hist_single_collective(rng):
     assert stall_sites, (shapes, k)
 
 
+# -- schema v7: provenance + sampled-sync runtime attribution ---------------
+
+def test_provenance_block(rng):
+    """Every enabled report carries the required who-produced-this block:
+    platform, jax version, host layout, the emulated flag (True off-TPU)
+    and the GBDT-known extras (tree_learner, learner class)."""
+    X, y = _problem(rng)
+    params = dict(_BASE, telemetry=True)
+    ds = lgb.Dataset(X, label=y, params=params)
+    bst = lgb.Booster(params, ds)
+    bst.update()
+    rep = bst.get_telemetry()
+    assert validate_report(rep) == []
+    prov = rep["provenance"]
+    assert prov["jax_version"] == jax.__version__
+    assert prov["num_devices"] == jax.device_count()
+    assert prov["emulated"] == (jax.devices()[0].platform != "tpu")
+    assert prov["tree_learner"] == "serial"
+    assert prov["learner"] == type(bst.gbdt.learner).__name__
+    # the disabled report has one too (schema: required section)
+    ds2 = lgb.Dataset(X, label=y, params=dict(_BASE))
+    bst2 = lgb.Booster(dict(_BASE), ds2)
+    bst2.update()
+    assert "provenance" in bst2.get_telemetry()
+
+
+def test_sampled_sync_attribution_coverage(rng):
+    """telemetry_sync_every=1: every iteration is bracketed with forced
+    syncs and the per-leg table must account for the measured iteration
+    wall within the acceptance bar (|1 - coverage| <= 0.1)."""
+    X, y = _problem(rng, n=4096)
+    params = dict(_BASE, telemetry=True, telemetry_sync_every=1)
+    ds = lgb.Dataset(X, label=y, params=params)
+    bst = lgb.Booster(params, ds)
+    for _ in range(6):
+        bst.update()
+    rep = bst.get_telemetry()
+    assert validate_report(rep) == []
+    dist = rep["distributed"]
+    assert dist["sync_every"] == 1
+    table = dist["attribution"]
+    assert table["sampled_iterations"] == 6
+    assert table["legs_ms"], table
+    assert abs(1.0 - table["coverage"]) <= 0.1, table
+    assert table["legs_sum_ms"] == pytest.approx(
+        sum(table["legs_ms"].values()))
+    # memory watermarks ride the same section (devices may be empty on
+    # backends without memory_stats — the KEY must exist)
+    assert "devices" in dist["memory"]
+
+
+def test_no_sync_phases_without_sampling(rng):
+    """With telemetry on but telemetry_sync_every unset, no iteration pays
+    the forced-sync bracket: no sync.* phases, no attribution table."""
+    X, y = _problem(rng)
+    params = dict(_BASE, telemetry=True)
+    ds = lgb.Dataset(X, label=y, params=params)
+    bst = lgb.Booster(params, ds)
+    for _ in range(3):
+        bst.update()
+    rep = bst.get_telemetry()
+    assert not [p for p in rep["phases"] if p.startswith("sync.")]
+    assert "attribution" not in rep["distributed"]
+
+
+def test_training_prometheus_renders(rng):
+    from lightgbm_tpu.observability.metrics_export import training_prometheus
+    X, y = _problem(rng, n=4096)
+    params = dict(_BASE, telemetry=True, telemetry_sync_every=2)
+    ds = lgb.Dataset(X, label=y, params=params)
+    bst = lgb.Booster(params, ds)
+    for _ in range(4):
+        bst.update()
+    text = training_prometheus(bst.get_telemetry())
+    assert "lgbt_training_iterations_total 4" in text
+    assert "lgbt_training_phase_iteration_total_seconds" in text
+    assert "lgbt_training_iteration_mean_ms" in text
+    # sampled-sync legs + coverage ride the same page
+    assert "lgbt_training_leg_ms:" in text
+    assert "lgbt_training_attribution_coverage" in text
+    # well-formed exposition: every non-comment line is "name value"
+    for ln in text.splitlines():
+        if ln and not ln.startswith("#"):
+            name, val = ln.rsplit(" ", 1)
+            float(val)
+
+
+def test_telemetry_off_model_bit_identical(rng):
+    """The whole observability layer is a no-op when disabled: the same
+    problem trains to a BYTE-identical model text with telemetry (and
+    sampling) on vs off."""
+    X, y = _problem(rng)
+    texts = {}
+    for tel in (False, True):
+        params = dict(_BASE, telemetry=tel, bagging_fraction=0.8,
+                      bagging_freq=1, feature_fraction=0.9, seed=3)
+        if tel:
+            params["telemetry_sync_every"] = 2
+        ds = lgb.Dataset(X, label=y, params=params)
+        bst = lgb.Booster(params, ds)
+        for _ in range(5):
+            bst.update()
+        texts[tel] = bst.model_to_string()
+    assert texts[False] == texts[True]
+
+
 # -- wave budget: batched-correction transient (satellite) ------------------
 
 def test_wave_budget_counts_stall_vec_transient():

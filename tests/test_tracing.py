@@ -278,6 +278,138 @@ def test_training_trace_off_is_noop_and_model_identical(rng):
                     "pipeline_flush"}
 
 
+# -- podtrace: per-rank export + cross-host merge ----------------------------
+
+class _FakeNet:
+    """Just enough DistributedNet surface for podtrace unit tests."""
+
+    def __init__(self, rank, num_machines=2, clock_offset_s=0.0):
+        self.rank = rank
+        self.num_machines = num_machines
+        self._off = clock_offset_s
+
+    def allgather(self, payload):
+        # rank 0's stamp on ITS clock: our clock minus the offset, posted
+        # "now" (inside the caller's send/recv window, so midpoint error
+        # is bounded by the call's rtt)
+        return [("clk", 0, time.perf_counter() - self._off), payload]
+
+
+def test_estimate_clock_offset_recovers_known_skew():
+    from lightgbm_tpu.observability import podtrace
+    off = podtrace.estimate_clock_offset(
+        _FakeNet(rank=1, clock_offset_s=0.25), rounds=4)
+    assert abs(off["offset_s"] - 0.25) < 0.01
+    assert off["method"] == "kv-ping-midpoint"
+    # rank 0 IS the reference clock, whatever its rounds measured
+    off0 = podtrace.estimate_clock_offset(
+        _FakeNet(rank=0, clock_offset_s=0.25), rounds=4)
+    assert off0["offset_s"] == 0.0
+
+
+def test_podtrace_merge_aligns_and_nests(tmp_path):
+    from lightgbm_tpu.observability import podtrace
+
+    clk = {"offset_s": 0.0, "rtt_s": 1e-4, "rounds": 8,
+           "method": "kv-ping-midpoint"}
+    r0 = TraceRecorder(True)
+    with r0.span("iteration"):
+        with r0.span("tree_dispatch"):
+            pass
+    time.sleep(0.02)
+    r1 = TraceRecorder(True)      # later epoch, same host clock
+    with r1.span("iteration"):
+        pass
+    base = str(tmp_path / "trace.json")
+    p0 = podtrace.export_rank_trace(r0, base, net=_FakeNet(0),
+                                    clock=dict(clk))
+    p1 = podtrace.export_rank_trace(r1, base, net=_FakeNet(1),
+                                    clock=dict(clk))
+    assert p0.endswith(".rank0") and p1.endswith(".rank1")
+    # single host: the path passes through unchanged
+    assert podtrace.rank_trace_path(base, 0, 1) == base
+    with open(p0) as fh:
+        meta0 = json.load(fh)["otherData"]
+    assert meta0["rank"] == 0 and meta0["process_count"] == 2
+    assert "aligned_epoch_us" in meta0
+
+    merged_path = str(tmp_path / "pod.json")
+    merged = podtrace.merge_pod_trace([p0, p1], out=merged_path)
+    with open(merged_path) as fh:            # valid Chrome trace JSON
+        reloaded = json.load(fh)
+    assert reloaded["otherData"]["pod_merge"] is True
+    ev = merged["traceEvents"]
+    assert {e["pid"] for e in ev} == {0, 1}  # pids rewritten to ranks
+    pnames = {e["pid"]: e["args"]["name"] for e in ev
+              if e.get("ph") == "M" and e["name"] == "process_name"}
+    assert pnames[0].startswith("rank 0")
+    assert pnames[1].startswith("rank 1")
+    # same-host clocks (offset 0): rank 1's later-recorded span must land
+    # LATER on the merged timeline than rank 0's earlier spans
+    t0_end = max(e["ts"] for e in ev
+                 if e["pid"] == 0 and e.get("ph") == "E")
+    t1_beg = min(e["ts"] for e in ev
+                 if e["pid"] == 1 and e.get("ph") == "B")
+    assert t1_beg > t0_end
+    # B/E well-nesting survives the merge on every (pid, tid) stream
+    stacks = {}
+    for e in ev:
+        if e.get("ph") == "B":
+            stacks.setdefault((e["pid"], e["tid"]), []).append(e["name"])
+        elif e.get("ph") == "E":
+            assert stacks[(e["pid"], e["tid"])].pop() == e["name"]
+    assert not any(stacks.values())
+    ts = [e["ts"] for e in ev if e.get("ph") in "BEi"]
+    assert ts == sorted(ts)                  # merged timeline is monotone
+
+
+def test_podtrace_offset_compensation(tmp_path):
+    """A rank whose clock runs 0.5 s AHEAD exports aligned_epoch 0.5 s
+    earlier; the merge therefore cancels the skew instead of showing the
+    rank half a second late."""
+    from lightgbm_tpu.observability import podtrace
+
+    r0 = TraceRecorder(True)
+    with r0.span("iteration"):
+        pass
+    r1 = TraceRecorder(True)
+    with r1.span("iteration"):
+        pass
+    base = str(tmp_path / "t.json")
+    clk0 = {"offset_s": 0.0, "rtt_s": 0.0, "rounds": 1, "method": "x"}
+    p0 = podtrace.export_rank_trace(r0, base, net=_FakeNet(0), clock=clk0)
+    skewed = {"offset_s": 0.5, "rtt_s": 0.0, "rounds": 1, "method": "x"}
+    p1 = podtrace.export_rank_trace(r1, base, net=_FakeNet(1), clock=skewed)
+    with open(p0) as fh:
+        e0 = json.load(fh)["otherData"]["aligned_epoch_us"]
+    with open(p1) as fh:
+        e1 = json.load(fh)["otherData"]["aligned_epoch_us"]
+    # r1 was created AFTER r0 on the same real clock, but claiming its
+    # clock is 0.5 s ahead pulls its aligned epoch ~0.5 s BEFORE r0's
+    assert e0 - e1 == pytest.approx(0.5e6, abs=0.1e6)
+    merged = podtrace.merge_pod_trace([p0, p1])
+    ranks = {m["rank"]: m for m in merged["otherData"]["ranks"]}
+    assert ranks[1]["clock_offset_us"] == pytest.approx(0.5e6)
+
+
+def test_podtrace_cli_merges(tmp_path, capsys):
+    from lightgbm_tpu.observability import podtrace
+
+    r = TraceRecorder(True)
+    with r.span("iteration"):
+        pass
+    p0 = str(tmp_path / "a.json")
+    r.save(p0)
+    out = str(tmp_path / "merged.json")
+    assert podtrace.main([out, p0, p0]) == 0
+    assert "merged 2 rank trace(s)" in capsys.readouterr().out
+    with open(out) as fh:
+        merged = json.load(fh)
+    # metadata-less inputs merge at offset 0 with list-index ranks
+    assert {e["pid"] for e in merged["traceEvents"]} <= {0, 1}
+    assert podtrace.main([out]) == 2         # usage error
+
+
 # -- bench_serving.py --------------------------------------------------------
 
 @pytest.mark.serving(timeout=300)
