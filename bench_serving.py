@@ -277,11 +277,16 @@ def main(argv: Optional[List[str]] = None) -> int:
         legs = None
         closed, open_, section = run_leg(args.protocol, args.replicas)
 
+    from lightgbm_tpu.observability import provenance_section
+
     report = {
         "schema_version": 1,
         "round": args.round,
         # the driver's TPU runs are the arbiter; CPU seeds are marked
         "platform": jax.devices()[0].platform,
+        # who-produced-this, same block as bench.py/MULTICHIP artifacts:
+        # platform, jax version, host/device counts, emulated flag
+        "provenance": provenance_section(),
         **({"note": args.note} if args.note else {}),
         "workload": {
             "model": args.model or "synthetic-binary",
@@ -305,6 +310,9 @@ def main(argv: Optional[List[str]] = None) -> int:
             "buckets": section["buckets"],
         },
     }
+    assert "provenance" in report and \
+        isinstance(report["provenance"].get("emulated"), bool), \
+        "BENCH_SERVING report lost its provenance block"
     errs = validate_report(report, BENCH_SERVING_SCHEMA)
     if errs:
         print(f"BENCH_SERVING report violates schema: {errs}",

@@ -57,6 +57,7 @@ DEFAULT_FILES = (
     os.path.join("lifecycle", "controller.py"),
     os.path.join("observability", "trace.py"),
     os.path.join("observability", "metrics_export.py"),
+    os.path.join("observability", "drift.py"),
 )
 
 _LOCK_FACTORIES = {"Lock", "RLock", "Condition", "Semaphore",

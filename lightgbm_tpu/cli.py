@@ -200,8 +200,11 @@ def run_serve(params: Dict[str, str], cfg: Config) -> None:
     fleet_kwargs = {}
     if cfg.serve_replicas:
         # any non-zero replica count serves through the async
-        # binary-protocol gateway (serving/fleet/); -1 = per-device
+        # binary-protocol gateway (serving/fleet/); -1 = per-device.
+        # Drift monitoring rides the fleet's recorder window
         fleet_kwargs["recovery_s"] = cfg.serve_recovery_s
+        fleet_kwargs["drift_psi_threshold"] = cfg.drift_psi_threshold
+        fleet_kwargs["drift_ks_threshold"] = cfg.drift_ks_threshold
     server = booster.serve(
         replicas=cfg.serve_replicas,
         host=cfg.serve_host, port=cfg.serve_port,
@@ -213,7 +216,9 @@ def run_serve(params: Dict[str, str], cfg: Config) -> None:
         trace_out=cfg.trace_out, trace_capacity=cfg.trace_capacity,
         stats_out=cfg.serve_stats_out,
         stats_interval_s=cfg.serve_stats_interval,
-        record_rows=cfg.lifecycle_record_rows, **fleet_kwargs)
+        record_rows=cfg.lifecycle_record_rows,
+        slo_p99_ms=cfg.serve_slo_p99_ms,
+        slo_target=cfg.serve_slo_target, **fleet_kwargs)
     if cfg.serve_replicas:
         _log(f"Serving {cfg.input_model} at {server.host}:{server.port} "
              f"with {len(server.replicas)} replica(s) "

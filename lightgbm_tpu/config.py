@@ -510,6 +510,20 @@ class Config:
     # ejection cooldown: a replica whose device path failed is excluded
     # from dispatch for this many seconds, then probed again
     serve_recovery_s: float = 1.0
+    # per-tenant SLO: every model name's requests are judged against
+    # this latency target; the `serving.tenants[]` report section and
+    # the lgbt_serving_tenant_* Prometheus series carry attainment
+    # (fraction of requests at or under the target) and error-budget
+    # burn ((1 - attainment) / (1 - serve_slo_target))
+    serve_slo_p99_ms: float = 50.0
+    serve_slo_target: float = 0.99
+    # drift detection thresholds (observability/drift.py, fleet serving
+    # with lifecycle_record_rows > 0): a feature or the score
+    # distribution is "drifted" when its PSI reaches drift_psi_threshold
+    # or its two-sample KS statistic reaches drift_ks_threshold with
+    # p < 0.05 against the baseline captured at promote time
+    drift_psi_threshold: float = 0.2
+    drift_ks_threshold: float = 0.15
     # --- lifecycle (lightgbm_tpu/lifecycle/) ---
     # bounded live-traffic ring in the serving server: the newest this
     # many request feature rows are retained for the lifecycle shadow

@@ -85,6 +85,23 @@ class TrafficRecorder:
             return np.concatenate([self._buf[self._next:],
                                    self._buf[:self._next]], axis=0)
 
+    def drain(self) -> np.ndarray:
+        """``snapshot()`` that also empties the ring (capacity and width
+        are kept), so consecutive drift checks judge DISJOINT traffic
+        windows instead of re-scoring overlapping rows.  ``total_rows``
+        keeps counting monotonically across drains."""
+        with self._lock:
+            if self._buf is None or self._size == 0:
+                return np.zeros((0, 0), np.float64)
+            if self._size < self.capacity:
+                out = self._buf[:self._size].copy()
+            else:
+                out = np.concatenate([self._buf[self._next:],
+                                      self._buf[:self._next]], axis=0)
+            self._next = 0
+            self._size = 0
+            return out
+
     def section(self) -> Dict[str, Any]:
         """The ``lifecycle.recorder`` report fragment."""
         with self._lock:

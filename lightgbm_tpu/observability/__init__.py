@@ -55,6 +55,22 @@ Schema v7 adds the distributed-training layer (ROADMAP items 1 & 2):
     section (rank skew, attribution table, memory watermarks);
     ``training_prometheus`` renders it as ``lgbt_training_*`` gauges.
 
+Schema v8 adds the fleet-serving monitoring layer (ROADMAP items 3c & 4
+prerequisite):
+
+  * ``drift`` (`drift.py`) — PSI and two-sample-KS detectors over
+    per-feature bin-index distributions (through the serving binner's
+    existing bins) and score distributions; baselines are captured from
+    the traffic recorder at promote time and later windows are compared
+    against them, emitting the optional ``drift`` report section,
+    ``lgbt_serving_drift_*`` gauges and ``drift.alert`` trace instants.
+  * per-tenant SLO metrics (`serving/batcher.py` ``TenantStats``) — a
+    per-model-name latency histogram + request/error/shed counters with
+    SLO attainment and error-budget burn, reported as
+    ``serving.tenants[]`` and scraped as ``lgbt_serving_tenant_*``
+    series; the fleet gateway additionally answers plain-HTTP
+    ``GET /metrics`` on its serving port.
+
 Device-side *time* attribution inside the fused tree program is out of
 scope for counters — that is what the opt-in ``profile_trace_dir``
 (`jax.profiler`) trace is for; see README "Telemetry & profiling" and
@@ -64,6 +80,7 @@ scope for counters — that is what the opt-in ``profile_trace_dir``
 from .attribution import (SampledSync, attribution_table, force_sync,
                           parse_profiler_trace, timeit)
 from .collectives import CollectiveLedger
+from .drift import DriftMonitor, ks_2samp, ks_from_counts, psi_from_counts
 from .metrics_export import (BENCH_SERVING_SCHEMA, LatencyHistogram,
                              prometheus_text, training_prometheus)
 from .podtrace import estimate_clock_offset, export_rank_trace, \
@@ -81,4 +98,6 @@ __all__ = ["Telemetry", "CollectiveLedger", "TEL_NAMES",
            "parse_profiler_trace", "timeit", "training_prometheus",
            "estimate_clock_offset", "export_rank_trace",
            "merge_pod_trace", "provenance_section",
-           "get_global_tracer", "set_global_tracer"]
+           "get_global_tracer", "set_global_tracer",
+           "DriftMonitor", "psi_from_counts", "ks_from_counts",
+           "ks_2samp"]

@@ -148,13 +148,82 @@ def prometheus_text(counters: Optional[Dict[str, float]] = None,
     return "\n".join(lines) + "\n"
 
 
+def _escape_label(value: str) -> str:
+    """Prometheus label-value escaping (backslash, quote, newline)."""
+    return str(value).replace("\\", "\\\\").replace('"', '\\"') \
+        .replace("\n", "\\n")
+
+
+def tenant_prometheus_lines(tenants: Iterable[Dict[str, Any]]
+                            ) -> List[str]:
+    """``lgbt_serving_tenant_*{model="..."}`` series from a
+    ``ServingStats.tenants_section()`` list: request/error/shed
+    counters, latency percentile gauges, SLO attainment and error-budget
+    burn per model name."""
+    metrics = [
+        ("lgbt_serving_tenant_requests_total", "counter",
+         lambda t: t["requests"]),
+        ("lgbt_serving_tenant_errors_total", "counter",
+         lambda t: t["errors"]),
+        ("lgbt_serving_tenant_shed_total", "counter",
+         lambda t: t["shed"]),
+        ("lgbt_serving_tenant_latency_p50_ms", "gauge",
+         lambda t: t["latency_ms"]["p50"]),
+        ("lgbt_serving_tenant_latency_p95_ms", "gauge",
+         lambda t: t["latency_ms"]["p95"]),
+        ("lgbt_serving_tenant_latency_p99_ms", "gauge",
+         lambda t: t["latency_ms"]["p99"]),
+        ("lgbt_serving_tenant_slo_p99_target_ms", "gauge",
+         lambda t: t["slo"]["p99_target_ms"]),
+        ("lgbt_serving_tenant_slo_target", "gauge",
+         lambda t: t["slo"]["target"]),
+        ("lgbt_serving_tenant_slo_attainment", "gauge",
+         lambda t: t["slo"]["attainment"]),
+        ("lgbt_serving_tenant_error_budget_burn", "gauge",
+         lambda t: t["slo"]["error_budget_burn"]),
+    ]
+    tenants = list(tenants)
+    lines: List[str] = []
+    for name, kind, get in metrics:
+        lines.append(f"# TYPE {name} {kind}")
+        for t in tenants:
+            lab = _escape_label(t["model"])
+            lines.append(f'{name}{{model="{lab}"}} {float(get(t)):g}')
+    return lines
+
+
+def drift_prometheus_lines(gauges: Dict[str, float],
+                           section: Optional[Dict[str, Any]] = None
+                           ) -> List[str]:
+    """``lgbt_serving_drift_*`` gauges from ``DriftMonitor.gauges()``,
+    plus per-feature PSI series for the last check's top drifted
+    features when the full ``drift`` section is supplied."""
+    lines: List[str] = []
+    for name, v in sorted((gauges or {}).items()):
+        n = sanitize_metric_name("lgbt_" + name)
+        lines.append(f"# TYPE {n} gauge")
+        lines.append(f"{n} {float(v):g}")
+    feats = [f for f in (section or {}).get("features", ())
+             if f["feature"] in (section or {}).get("top_features", ())]
+    if feats:
+        lines.append("# TYPE lgbt_serving_drift_feature_psi gauge")
+        for f in feats:
+            lab = _escape_label(f["feature"])
+            lines.append(f'lgbt_serving_drift_feature_psi'
+                         f'{{feature="{lab}"}} {float(f["psi"]):g}')
+    return lines
+
+
 def prometheus_snapshot(stats, registry=None, admission=None,
-                        replicas=None) -> str:
+                        replicas=None, tenants=None, drift=None) -> str:
     """The server ``metrics`` op payload: every serving counter, stage
     timer total, reliability counter, model version and the request
     latency histogram, as one Prometheus text page.  ``replicas`` (a
     ``ReplicaSet.section()`` list) adds per-replica fleet gauges:
-    health, in-flight, dispatched, ejections, p99."""
+    health, in-flight, dispatched, ejections, p99; ``tenants`` (a
+    ``ServingStats.tenants_section()`` list) adds the per-model-name
+    SLO series and ``drift`` (a ``drift`` report section) the
+    ``lgbt_serving_drift_*`` gauges."""
     from ..reliability.metrics import rel_counters
 
     section = stats.serving_section(
@@ -201,9 +270,22 @@ def prometheus_snapshot(stats, registry=None, admission=None,
             snap["ejections"]
         gauges[f"serving_replica_latency_p99_ms:{i}"] = \
             snap["latency_ms"]["p99"]
-    return prometheus_text(
+    text = prometheus_text(
         counters, gauges,
         histograms={"serving_request_latency_seconds": stats.request_hist})
+    extra: List[str] = []
+    if tenants:
+        extra.extend(tenant_prometheus_lines(tenants))
+    if drift:
+        from .drift import DriftMonitor
+        if isinstance(drift, DriftMonitor):
+            extra.extend(drift_prometheus_lines(
+                drift.gauges(), drift.section()))
+        else:
+            extra.extend(drift_prometheus_lines(drift))
+    if extra:
+        text += "\n".join(extra) + "\n"
+    return text
 
 
 def training_prometheus(report: Dict[str, Any]) -> str:
@@ -290,12 +372,30 @@ _LOOP_SCHEMA = {
 BENCH_SERVING_SCHEMA: Dict[str, Any] = {
     "type": "object",
     "required": ["schema_version", "round", "platform", "workload",
-                 "closed_loop", "open_loop", "server"],
+                 "closed_loop", "open_loop", "server", "provenance"],
     "properties": {
         "schema_version": {"type": "integer"},
         "round": {"type": "integer"},
         "platform": {"type": "string"},
         "note": {"type": "string"},
+        # the same who-produced-this block every telemetry report and
+        # BENCH/MULTICHIP writer carries (schema v7): a CPU-emulated
+        # serving number can never masquerade as a device result
+        "provenance": {
+            "type": "object",
+            "required": ["platform", "jax_version", "num_devices",
+                         "num_hosts", "emulated"],
+            "properties": {
+                "platform": {"type": "string"},
+                "device_kind": {"type": "string"},
+                "jax_version": {"type": "string"},
+                "num_devices": {"type": "integer"},
+                "num_hosts": {"type": "integer"},
+                "process_index": {"type": "integer"},
+                "emulated": {"type": "boolean"},
+                "mesh_shape": {"type": ["string", "null"]},
+            },
+        },
         "workload": {
             "type": "object",
             "required": ["num_features", "rows_per_request"],

@@ -62,7 +62,12 @@ TEL_NAMES = {
 # (per-rank step timings + skew, sampled-sync attribution table, memory
 # watermarks, clock-offset handshake — `observability/attribution.py` /
 # `observability/podtrace.py`)
-SCHEMA_VERSION = 7
+# v8: serving section gains "tenants" (per-model-name latency histogram,
+# request/error/shed counters and SLO attainment / error-budget burn —
+# `serving/batcher.py` TenantStats) and reports gain an optional "drift"
+# section (PSI/KS baseline-vs-window verdict over the traffic recorder —
+# `observability/drift.py`)
+SCHEMA_VERSION = 8
 
 
 def provenance_section(extra: Optional[Dict[str, Any]] = None

@@ -45,17 +45,82 @@ def bucket_ladder(min_bucket: int, max_rows: int) -> List[int]:
     return out
 
 
+class TenantStats:
+    """Per-model-name ("tenant") serving metrics: an admission→response
+    ``LatencyHistogram`` plus request/error/shed counters and the SLO
+    view (attainment against a latency target, error-budget burn).
+
+    Lock-leaf like the histogram it wraps: its one lock guards the
+    counters only and nothing is called while holding it."""
+
+    __slots__ = ("name", "hist", "_lock", "requests", "errors", "shed",
+                 "within_slo")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.hist = LatencyHistogram()
+        self._lock = threading.Lock()
+        self.requests = 0
+        self.errors = 0
+        self.shed = 0
+        self.within_slo = 0
+
+    def record(self, ms: float, slo_p99_ms: float,
+               error: bool = False) -> None:
+        self.hist.record(ms)
+        with self._lock:
+            self.requests += 1
+            if error:
+                self.errors += 1
+            if ms <= slo_p99_ms:
+                self.within_slo += 1
+
+    def record_shed(self) -> None:
+        with self._lock:
+            self.shed += 1
+
+    def record_error(self) -> None:
+        """An error WITHOUT a latency sample — the control-plane path
+        (failed swap, unknown op), so the tenant's error rate sees every
+        failure, not just predict errors."""
+        with self._lock:
+            self.errors += 1
+
+    def section(self, slo_p99_ms: float, slo_target: float
+                ) -> Dict[str, Any]:
+        # histogram snapshot first: its lock stays leaf beside ours
+        latency = self.hist.snapshot()
+        with self._lock:
+            requests, errors = self.requests, self.errors
+            shed, within = self.shed, self.within_slo
+        attainment = within / requests if requests else 1.0
+        budget = max(1.0 - float(slo_target), 1e-9)
+        return {"model": self.name,
+                "requests": requests,
+                "errors": errors,
+                "shed": shed,
+                "latency_ms": latency,
+                "slo": {"p99_target_ms": float(slo_p99_ms),
+                        "target": float(slo_target),
+                        "attainment": attainment,
+                        "error_budget_burn": (1.0 - attainment) / budget}}
+
+
 class ServingStats:
     """Thread-safe serving counters + stage phase timers.
 
     Stage timers reuse ``Telemetry`` phases (named ``serve_<stage>``), so
     they show up both in the standard ``phases`` section and, summarized,
-    under ``serving.stage_ms``.
+    under ``serving.stage_ms``.  Per-model-name ``TenantStats`` hang off
+    the same object (the fleet gateway and the threaded server both
+    record into them at dispatch completion), surfacing as the schema-v8
+    ``serving.tenants[]`` section and the ``lgbt_serving_tenant_*``
+    Prometheus series.
     """
 
     STAGES = ("queue", "pad", "bin", "traverse", "unpad", "fallback")
 
-    def __init__(self):
+    def __init__(self, slo_p99_ms: float = 50.0, slo_target: float = 0.99):
         self.tel = Telemetry(True)
         # per-request end-to-end latency (admission → response), backing
         # the serving section's exact p50/p95/p99 and the Prometheus
@@ -76,6 +141,12 @@ class ServingStats:
         self.errors = 0
         self.fallback_batches = 0
         self.fallback_rows = 0
+        # per-tenant metrics under their own leaf lock (the request path
+        # must never take self._lock just to find its tenant)
+        self.slo_p99_ms = float(slo_p99_ms)
+        self.slo_target = float(slo_target)
+        self._tenants: Dict[str, TenantStats] = {}
+        self._tenants_lock = threading.Lock()
 
     @property
     def tracer(self):
@@ -131,6 +202,43 @@ class ServingStats:
             self.errors += 1
         rel_inc("serve.request_errors")
 
+    def configure_slo(self, p99_ms: float, target: float) -> None:
+        """Set the latency SLO every tenant is judged against
+        (``serve_slo_p99_ms`` / ``serve_slo_target`` config keys)."""
+        self.slo_p99_ms = float(p99_ms)
+        self.slo_target = float(target)
+
+    def tenant(self, name: str) -> TenantStats:
+        """The (lazily created) per-model-name metrics bundle."""
+        with self._tenants_lock:
+            t = self._tenants.get(name)
+            if t is None:
+                t = self._tenants[name] = TenantStats(name)
+            return t
+
+    def record_tenant_request(self, name: str, ms: float,
+                              error: bool = False) -> None:
+        """One completed (admission→response) request for a tenant —
+        recorded in the dispatch ``finally`` beside the global
+        ``record_request_latency``."""
+        self.tenant(name).record(ms, self.slo_p99_ms, error=error)
+
+    def record_tenant_shed(self, name: str) -> None:
+        self.tenant(name).record_shed()
+
+    def record_tenant_error(self, name: str) -> None:
+        """Control-plane failure attributed to a tenant (no latency
+        sample): failed swaps and malformed ops burn the same error
+        budget the rollback watchdog reads."""
+        self.tenant(name).record_error()
+
+    def tenants_section(self) -> List[Dict[str, Any]]:
+        """``serving.tenants[]``: one section per model name, sorted."""
+        with self._tenants_lock:
+            tenants = sorted(self._tenants.values(), key=lambda t: t.name)
+        return [t.section(self.slo_p99_ms, self.slo_target)
+                for t in tenants]
+
     def record_fallback(self, rows: int) -> None:
         from ..reliability.metrics import rel_inc
         with self._lock:
@@ -141,9 +249,10 @@ class ServingStats:
 
     def serving_section(self, models: Optional[Dict[str, int]] = None,
                         jit_entries: Optional[int] = None) -> Dict[str, Any]:
-        # histogram snapshot BEFORE self._lock: the histogram's own lock
-        # stays leaf (no nested acquisition for the race detector to chew)
+        # histogram/tenant snapshots BEFORE self._lock: their locks stay
+        # leaf (no nested acquisition for the race detector to chew)
         latency = self.request_hist.snapshot()
+        tenants = self.tenants_section()
         with self._lock:
             elapsed = max(time.monotonic() - self._t0, 1e-9)
             stage_ms = {}
@@ -172,6 +281,7 @@ class ServingStats:
                 "fallback_batches": self.fallback_batches,
                 "fallback_rows": self.fallback_rows,
                 "latency_ms": latency,
+                "tenants": tenants,
             }
 
     def report(self, models: Optional[Dict[str, int]] = None,

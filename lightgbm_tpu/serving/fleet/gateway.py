@@ -10,10 +10,14 @@ workers stay threaded and respond through a cross-thread outbuf +
 socketpair wakeup, so the loop never blocks on device work and device
 work never touches a socket.
 
-Both protocols on one port: the first 4 bytes of a connection decide —
-``LGBT`` means binary wire frames (`wire.py`), anything else is the
-legacy 8-byte-length + pickle framing, so old ``ServingClient``s keep
-working unmodified.  Corrupt binary headers follow wire.py's defined
+Three protocols on one port: the first 4 bytes of a connection decide —
+``LGBT`` means binary wire frames (`wire.py`), ``GET `` (or ``HEAD``)
+means a plain-HTTP Prometheus scrape of ``/metrics`` (one HTTP/1.0
+response assembled from the fleet-aggregated snapshot, then close), and
+anything else is the legacy 8-byte-length + pickle framing, so old
+``ServingClient``s keep working unmodified and a stock Prometheus
+scrapes the gateway with zero custom tooling.  Corrupt binary headers
+follow wire.py's defined
 resync-or-close behavior: an oversize length on a well-formed header
 gets a structured error frame then close; a bad magic/version closes
 immediately (no trustable frame boundary remains).
@@ -52,6 +56,7 @@ _NULL_CTX = contextlib.nullcontext()
 from ...io.net import DEFAULT_MAX_FRAME_BYTES, _LEN
 from ...lifecycle.recorder import TrafficRecorder
 from ...lifecycle.shadow import shadow_validate
+from ...observability.drift import DriftMonitor
 from ...observability.trace import TraceRecorder, new_trace_id
 from ...reliability.degrade import AdmissionController
 from ...reliability.metrics import rel_inc
@@ -93,14 +98,19 @@ class FleetServer:
                  trace_out: str = "", trace_capacity: int = 65536,
                  stats_out: str = "", stats_interval_s: float = 10.0,
                  record_rows: int = 0, recovery_s: float = 1.0,
-                 max_frame_bytes: int = DEFAULT_MAX_FRAME_BYTES):
+                 max_frame_bytes: int = DEFAULT_MAX_FRAME_BYTES,
+                 slo_p99_ms: float = 50.0, slo_target: float = 0.99,
+                 drift_psi_threshold: float = 0.2,
+                 drift_ks_threshold: float = 0.15,
+                 drift_min_rows: int = 32):
         self.host = host
         self.port = int(port)
         self.request_timeout = float(request_timeout)
         self.max_frame_bytes = int(max_frame_bytes)
         self.telemetry_out = telemetry_out
         self.admission = AdmissionController(max_inflight)
-        self.stats = ServingStats()
+        self.stats = ServingStats(slo_p99_ms=slo_p99_ms,
+                                  slo_target=slo_target)
         self.tracer: Optional[TraceRecorder] = None
         if trace or trace_out:
             self.tracer = TraceRecorder(True, capacity=trace_capacity)
@@ -109,6 +119,14 @@ class FleetServer:
         self.stats_out = stats_out
         self.stats_interval_s = float(stats_interval_s)
         self.recorder = TrafficRecorder(record_rows)
+        # drift detection over the recorder window (observability/
+        # drift.py): a no-op until a baseline is captured, which only
+        # happens when the recorder is enabled — telemetry off keeps the
+        # request path free of any drift work
+        self.drift = DriftMonitor(psi_threshold=drift_psi_threshold,
+                                  ks_threshold=drift_ks_threshold,
+                                  min_rows=drift_min_rows,
+                                  tracer=self.tracer)
         self.lifecycle = None
         self.replicas = ReplicaSet(
             stats=self.stats, replicas=replicas,
@@ -192,7 +210,45 @@ class FleetServer:
         rep["serving"]["replicas"] = self.replicas.section()
         if self.lifecycle is not None:
             rep["lifecycle"] = self.lifecycle.section()
+        drift = self.check_drift()
+        if drift is not None:
+            rep["drift"] = drift
         return rep
+
+    # -- drift monitoring ----------------------------------------------------
+
+    def capture_drift_baseline(self, name: str = "default") -> bool:
+        """Snapshot the current recorder window as the drift baseline
+        for one model — called after every committed promotion, and
+        callable by operators/tests directly.  False (nothing captured)
+        when recording is off, the window is under the monitor's
+        ``min_rows`` or no model by that name is live."""
+        if not self.recorder.enabled:
+            return False
+        try:
+            model = self.replicas.get(name)
+        except KeyError:
+            return False
+        return self.drift.capture(model, self.recorder.snapshot())
+
+    def check_drift(self, name: str = "default",
+                    drain: bool = False) -> Optional[Dict[str, Any]]:
+        """Compare the recorder window against the captured baseline →
+        the ``drift`` report section (None when recording is off or no
+        baseline exists — the proven telemetry-off no-op).  ``drain``
+        empties the ring so consecutive checks judge disjoint windows;
+        the default non-destructive snapshot keeps the window available
+        for the lifecycle shadow replay."""
+        if not self.recorder.enabled or not self.drift.has_baseline(name):
+            return None
+        try:
+            model = self.replicas.get(name)
+        except KeyError:
+            return None
+        X = self.recorder.drain() if drain else self.recorder.snapshot()
+        if X.size == 0:
+            return self.drift.section(name)
+        return self.drift.check(model, X) or self.drift.section(name)
 
     def trace(self) -> Optional[Dict[str, Any]]:
         return self.tracer.export() if self.tracer is not None else None
@@ -257,6 +313,11 @@ class FleetServer:
                 prepared, settle_s=settle_s)
             out["committed"] = True
             rel_inc("serve.fleet_promotions")
+            # the traffic the new version was judged on becomes its
+            # drift baseline: later windows are compared against the
+            # distribution that was live at promote time
+            if self.recorder.enabled and X.size:
+                out["drift_baseline"] = self.drift.capture(prepared[0], X)
             return out
 
     def rollback_fleet(self, name: str = "default") -> Dict[str, Any]:
@@ -444,10 +505,20 @@ class FleetServer:
         if conn.protocol is None:
             if len(conn.inbuf) < len(wire.MAGIC):
                 return
-            conn.protocol = "binary" \
-                if bytes(conn.inbuf[:4]) == wire.MAGIC else "pickle"
+            # three protocols, one port, one 4-byte sniff: the wire
+            # magic means binary frames, an HTTP method means a plain
+            # Prometheus scrape, anything else is legacy pickle framing
+            head = bytes(conn.inbuf[:4])
+            if head == wire.MAGIC:
+                conn.protocol = "binary"
+            elif head in (b"GET ", b"HEAD"):
+                conn.protocol = "http"
+            else:
+                conn.protocol = "pickle"
         if conn.protocol == "binary":
             self._parse_binary(conn)
+        elif conn.protocol == "http":
+            self._parse_http(conn)
         else:
             self._parse_pickle(conn)
 
@@ -463,6 +534,52 @@ class FleetServer:
             self._handle_binary(conn, opcode, flags, tid, payload)
             if conn.sock not in self._conns:
                 return
+
+    # upper bound on an HTTP request head: a scrape request is a few
+    # hundred bytes; anything bigger is not a scraper
+    _HTTP_MAX_HEAD = 16384
+
+    def _parse_http(self, conn: _Conn) -> None:
+        """The Prometheus scrape protocol: wait for one complete request
+        head, answer one HTTP/1.0 response assembled from the
+        fleet-aggregated snapshot, close.  Loop thread only — the page
+        render is host-side string work, never a device call."""
+        end = conn.inbuf.find(b"\r\n\r\n")
+        if end < 0:
+            if len(conn.inbuf) > self._HTTP_MAX_HEAD:
+                self._close_conn(conn)
+            return
+        head = bytes(conn.inbuf[:end]).decode("latin-1", "replace")
+        del conn.inbuf[:]
+        parts = head.split("\r\n", 1)[0].split()
+        method = parts[0].upper() if parts else "GET"
+        path = parts[1].split("?", 1)[0] if len(parts) >= 2 else ""
+        if path == "/metrics":
+            status, ctype = "200 OK", "text/plain; version=0.0.4; " \
+                                      "charset=utf-8"
+            body = self._prometheus_page()
+        else:
+            status, ctype = "404 Not Found", "text/plain; charset=utf-8"
+            body = "not found (scrape /metrics)\n"
+        rel_inc("serve.fleet_http_scrapes")
+        payload = body.encode("utf-8")
+        resp = (f"HTTP/1.0 {status}\r\n"
+                f"Content-Type: {ctype}\r\n"
+                f"Content-Length: {len(payload)}\r\n"
+                f"Connection: close\r\n\r\n").encode("ascii")
+        if method != "HEAD":
+            resp += payload
+        self._send_bytes(conn, resp, close=True)
+
+    def _prometheus_page(self) -> str:
+        """The fleet-aggregated Prometheus exposition: gateway counters
+        + admission + every replica + per-tenant SLO series + drift
+        gauges — the same text the binary/pickle ``metrics`` op returns."""
+        from ...observability.metrics_export import prometheus_snapshot
+        return prometheus_snapshot(
+            self.stats, registry=self.replicas, admission=self.admission,
+            replicas=self.replicas.section(),
+            tenants=self.stats.tenants_section(), drift=self.drift)
 
     def _parse_pickle(self, conn: _Conn) -> None:
         while len(conn.inbuf) >= _LEN.size:
@@ -533,12 +650,12 @@ class FleetServer:
         elif op == "stats":
             resp = {"ok": True, "report": self.report()}
         elif op == "metrics":
-            from ...observability.metrics_export import prometheus_snapshot
+            # refresh the drift verdict so a scrape-by-op sees the same
+            # data the stats report carries, then render the one page
+            # the HTTP endpoint also serves
+            self.check_drift()
             resp = {"ok": True,
-                    "text": prometheus_snapshot(
-                        self.stats, registry=self.replicas,
-                        admission=self.admission,
-                        replicas=self.replicas.section()),
+                    "text": self._prometheus_page(),
                     "content_type": "text/plain; version=0.0.4"}
         elif op == "swap":
             def _swap():
@@ -556,6 +673,13 @@ class FleetServer:
                 except Exception as e:
                     r = {"ok": False,
                          "error": f"{type(e).__name__}: {e}"}
+                if not r.get("ok"):
+                    # control-plane failure: burn the tenant's error
+                    # budget too, so the rollback watchdog's error-rate
+                    # deltas see failed swaps, not just predict errors
+                    self.stats.record_error()
+                    self.stats.record_tenant_error(
+                        str(msg.get("model", "default")))
                 self._send_bytes(conn, self._encode_resp(
                     conn, r, opcode or wire.OP_SWAP, trace_id))
             threading.Thread(target=_swap, name="lgbt-fleet-swap",
@@ -570,6 +694,9 @@ class FleetServer:
             return
         else:
             resp = {"ok": False, "error": f"unknown op {op!r}"}
+            self.stats.record_error()
+            self.stats.record_tenant_error(str(msg.get("model",
+                                                       "default")))
         self._send_bytes(conn, self._encode_resp(conn, resp,
                                                  opcode, trace_id))
 
@@ -579,6 +706,7 @@ class FleetServer:
                            else "")
         if not self.admission.try_acquire():
             self.stats.record_shed()
+            self.stats.record_tenant_shed(name)
             resp = {"ok": False, "error": "overloaded", "shed": True,
                     "inflight": self.admission.inflight,
                     "capacity": self.admission.capacity}
@@ -616,8 +744,10 @@ class FleetServer:
                         conn, resp, opcode, tid))
                 finally:
                     self.admission.release()
-                    self.stats.record_request_latency(
-                        (time.perf_counter() - t0) * 1e3)
+                    ms = (time.perf_counter() - t0) * 1e3
+                    self.stats.record_request_latency(ms)
+                    self.stats.record_tenant_request(
+                        name, ms, error=handle.error is not None)
 
             with span:
                 replica.submit_async(X, name, _done, trace_id=tid or None)
@@ -626,8 +756,9 @@ class FleetServer:
             # admission slot releases HERE because no callback will
             self.stats.record_error()
             self.admission.release()
-            self.stats.record_request_latency(
-                (time.perf_counter() - t0) * 1e3)
+            ms = (time.perf_counter() - t0) * 1e3
+            self.stats.record_request_latency(ms)
+            self.stats.record_tenant_request(name, ms, error=True)
             resp = {"ok": False, "error": f"{type(e).__name__}: {e}"}
             if tid:
                 resp["trace_id"] = tid

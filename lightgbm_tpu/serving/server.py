@@ -103,7 +103,8 @@ class PredictionServer:
                  max_inflight: int = 64, trace: bool = False,
                  trace_out: str = "", trace_capacity: int = 65536,
                  stats_out: str = "", stats_interval_s: float = 10.0,
-                 record_rows: int = 0):
+                 record_rows: int = 0, slo_p99_ms: float = 50.0,
+                 slo_target: float = 0.99):
         self.host = host
         self.port = int(port)
         self.max_batch_rows = int(max_batch_rows)
@@ -112,7 +113,8 @@ class PredictionServer:
         self.telemetry_out = telemetry_out
         self.request_timeout = float(request_timeout)
         self.admission = AdmissionController(max_inflight)
-        self.stats = ServingStats()
+        self.stats = ServingStats(slo_p99_ms=slo_p99_ms,
+                                  slo_target=slo_target)
         # request-scoped tracing: host-side spans only, written as Chrome
         # trace-event JSON on stop (open in Perfetto)
         self.trace_out = trace_out
@@ -320,6 +322,7 @@ class PredictionServer:
                     "versions": self.registry.versions_detail(),
                     **self.admission.snapshot()}
         if op == "predict":
+            name = str(msg.get("model", "default"))
             # the request's causal id: client-supplied, or minted here
             # when tracing so every request is attributable in the trace
             trace_id = msg.get("trace_id") or \
@@ -331,6 +334,7 @@ class PredictionServer:
             # rejection with its own request records
             if not self.admission.try_acquire():
                 self.stats.record_shed()
+                self.stats.record_tenant_shed(name)
                 resp = {"ok": False, "error": "overloaded", "shed": True,
                         "inflight": self.admission.inflight,
                         "capacity": self.admission.capacity}
@@ -338,8 +342,8 @@ class PredictionServer:
                     resp["trace_id"] = trace_id
                 return resp
             t0 = time.perf_counter()
+            failed = False
             try:
-                name = msg.get("model", "default")
                 model = self.registry.get(name)
                 X = np.atleast_2d(np.asarray(msg["data"], dtype=np.float64))
                 # lifecycle traffic capture: the shadow loop replays
@@ -362,14 +366,16 @@ class PredictionServer:
                 # an admitted request answering with an error frame — the
                 # rate the lifecycle rollback watchdog judges a fresh
                 # promotion by
+                failed = True
                 self.stats.record_error()
                 raise
             finally:
                 self.admission.release()
                 # admission→response latency, errors included — the p99
                 # an external client actually observes server-side
-                self.stats.record_request_latency(
-                    (time.perf_counter() - t0) * 1e3)
+                ms = (time.perf_counter() - t0) * 1e3
+                self.stats.record_request_latency(ms)
+                self.stats.record_tenant_request(name, ms, error=failed)
         if op == "swap":
             version = self.registry.load(
                 msg.get("model", "default"), model_str=msg.get("model_str"),
@@ -383,9 +389,10 @@ class PredictionServer:
             # CLI; le buckets in seconds, counters monotone
             from ..observability.metrics_export import prometheus_snapshot
             return {"ok": True,
-                    "text": prometheus_snapshot(self.stats,
-                                                registry=self.registry,
-                                                admission=self.admission),
+                    "text": prometheus_snapshot(
+                        self.stats, registry=self.registry,
+                        admission=self.admission,
+                        tenants=self.stats.tenants_section()),
                     "content_type": "text/plain; version=0.0.4"}
         if op == "shutdown":
             # ack first; stop from a side thread (stop() joins batcher

@@ -1,0 +1,187 @@
+"""Drift detection (observability/drift.py): PSI/KS math, the
+DriftMonitor baseline-vs-window verdicts through a real served model's
+bin space, and the telemetry-off no-op contract."""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.observability import (DriftMonitor, ks_2samp,
+                                        ks_from_counts, psi_from_counts,
+                                        validate_report)
+from lightgbm_tpu.serving.registry import ServingModel
+
+from test_serving import _fuzz_matrix, _train
+
+
+@pytest.fixture(scope="module")
+def bst():
+    return _train(np.random.RandomState(21), trees=6)
+
+
+@pytest.fixture(scope="module")
+def model(bst):
+    return ServingModel(bst)
+
+
+def _shifted(rng, n=400):
+    """Fuzz traffic with features 0 and 2 pushed far off the train
+    distribution (the injected drift the detector must name)."""
+    X = _fuzz_matrix(rng, n)
+    X[:, 0] = np.nan_to_num(X[:, 0]) + 6.0
+    X[:, 2] = X[:, 2] * 0.05 + 80.0
+    return X
+
+
+# -- detector math -----------------------------------------------------------
+
+def test_psi_identical_and_shifted_counts():
+    same = np.array([40, 30, 20, 10])
+    assert psi_from_counts(same, same) == pytest.approx(0.0, abs=1e-12)
+    assert psi_from_counts(same, same * 7) == pytest.approx(0.0, abs=1e-12)
+    shifted = np.array([5, 10, 30, 55])
+    assert psi_from_counts(same, shifted) > 0.2
+    # degenerate histograms never divide by zero
+    assert psi_from_counts(np.zeros(4), shifted) == 0.0
+
+
+def test_ks_from_counts_bounds_and_pvalue():
+    same = np.array([100, 100, 100, 100])
+    stat, p = ks_from_counts(same, same)
+    assert stat == 0.0 and p == 1.0
+    disjoint_a = np.array([200, 200, 0, 0])
+    disjoint_b = np.array([0, 0, 200, 200])
+    stat, p = ks_from_counts(disjoint_a, disjoint_b)
+    assert stat == pytest.approx(1.0)
+    assert p < 1e-6
+
+
+def test_ks_2samp_raw_samples():
+    rng = np.random.RandomState(0)
+    a = rng.randn(600)
+    stat, p = ks_2samp(a, a)
+    assert stat == 0.0 and p == 1.0
+    stat, p = ks_2samp(a, rng.randn(600) + 2.0)
+    assert stat > 0.5 and p < 1e-6
+    # same distribution, different draws: small stat, large p
+    stat, p = ks_2samp(a, rng.randn(600))
+    assert stat < 0.15 and p > 0.05
+
+
+# -- DriftMonitor over a real model's bin space ------------------------------
+
+def test_monitor_identical_window_no_alert(model):
+    rng = np.random.RandomState(3)
+    mon = DriftMonitor(min_rows=32)
+    assert mon.capture(model, _fuzz_matrix(rng, 500))
+    sec = mon.check(model, _fuzz_matrix(rng, 500))
+    assert sec is not None and validate_drift_section(sec)
+    assert sec["drifted"] is False
+    assert sec["top_features"] == []
+    assert sec["max_psi"] < 0.2
+    assert sec["score"]["drifted"] is False
+    assert sec["checks"] == 1 and sec["alerts"] == 0
+    # the gauges read the same verdict the check produced
+    g = mon.gauges()
+    assert g["serving_drift_drifted"] == 0.0
+    assert g["serving_drift_window_rows"] == 500.0
+
+
+def test_monitor_shifted_window_trips_and_names_features(model):
+    rng = np.random.RandomState(4)
+    mon = DriftMonitor(min_rows=32)
+    assert mon.capture(model, _fuzz_matrix(rng, 500))
+    sec = mon.check(model, _shifted(rng, 500))
+    assert sec is not None and validate_drift_section(sec)
+    assert sec["drifted"] is True
+    # the two injected features lead the ranking
+    assert {"Column_0", "Column_2"} <= set(sec["top_features"])
+    by_name = {f["feature"]: f for f in sec["features"]}
+    assert by_name["Column_0"]["drifted"] and by_name["Column_2"]["drifted"]
+    assert by_name["Column_0"]["psi"] > 0.2
+    assert by_name["Column_0"]["ks_p"] < 0.05
+    # features list is ranked by PSI descending
+    psis = [f["psi"] for f in sec["features"]]
+    assert psis == sorted(psis, reverse=True)
+    # the margin distribution moved with the inputs
+    assert sec["score"]["drifted"] is True
+    g = mon.gauges()
+    assert g["serving_drift_drifted"] == 1.0
+    assert g["serving_drift_alerts_total"] == 1.0
+
+
+def test_monitor_min_rows_and_recapture(model):
+    rng = np.random.RandomState(5)
+    mon = DriftMonitor(min_rows=64)
+    assert not mon.capture(model, _fuzz_matrix(rng, 10))
+    assert not mon.has_baseline("default")
+    assert mon.check(model, _fuzz_matrix(rng, 200)) is None
+    assert mon.capture(model, _fuzz_matrix(rng, 200))
+    assert mon.check(model, _fuzz_matrix(rng, 10)) is None  # window too small
+    sec = mon.check(model, _shifted(rng, 200))
+    assert sec is not None and sec["drifted"]
+    # re-capture resets the verdict: section() forgets the old alert
+    assert mon.capture(model, _shifted(rng, 200))
+    assert mon.section("default") is None
+    sec = mon.check(model, _shifted(rng, 200))
+    assert sec is not None and sec["drifted"] is False
+
+
+def test_drift_alert_emits_trace_instant(model):
+    from lightgbm_tpu.observability import TraceRecorder
+    rng = np.random.RandomState(6)
+    tr = TraceRecorder(capacity=64)
+    mon = DriftMonitor(min_rows=32, tracer=tr)
+    mon.capture(model, _fuzz_matrix(rng, 300))
+    mon.check(model, _shifted(rng, 300))
+    names = [e["name"] for e in tr.export()["traceEvents"]]
+    assert "drift.alert" in names
+
+
+def validate_drift_section(sec):
+    """Wrap the section in a minimal report so the checked-in schema
+    validates the drift shape itself."""
+    from lightgbm_tpu.observability.telemetry import SCHEMA_VERSION
+    from lightgbm_tpu.serving.batcher import ServingStats
+    rep = ServingStats().report()
+    assert rep["schema_version"] == SCHEMA_VERSION == 8
+    rep["drift"] = sec
+    errs = validate_report(rep)
+    assert errs == [], errs
+    return True
+
+
+# -- telemetry-off no-op ------------------------------------------------------
+
+@pytest.mark.serving
+def test_record_rows_zero_is_a_drift_noop(bst):
+    """record_rows=0 (the default): no recorder ring, capture/check are
+    inert, no drift section in the report, and predictions are
+    bit-identical to a monitored fleet's."""
+    from lightgbm_tpu.serving import FleetServer, ServingClient
+    rng = np.random.RandomState(9)
+    X = _fuzz_matrix(rng, 64)
+    server = FleetServer(booster=bst, replicas=1, max_batch_rows=64,
+                         min_bucket=16).start()
+    try:
+        assert server.recorder.enabled is False
+        assert server.capture_drift_baseline() is False
+        assert server.check_drift() is None
+        with ServingClient("127.0.0.1", server.port,
+                           protocol="binary") as c:
+            off = np.asarray(c.predict(X))
+            rep = c.stats()
+        assert "drift" not in rep
+        assert validate_report(rep) == []
+    finally:
+        server.stop()
+    server = FleetServer(booster=bst, replicas=1, max_batch_rows=64,
+                         min_bucket=16, record_rows=256).start()
+    try:
+        with ServingClient("127.0.0.1", server.port,
+                           protocol="binary") as c:
+            on = np.asarray(c.predict(X))
+        assert server.capture_drift_baseline() is True
+    finally:
+        server.stop()
+    np.testing.assert_array_equal(off, on)

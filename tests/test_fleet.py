@@ -485,6 +485,220 @@ def test_fleet_report_schema_and_metrics(rng, bst_a):
     assert "lgbt_serving_replica_dispatched_total:0" in text
 
 
+def _drifted_matrix(rng, n):
+    """Fuzz traffic with feature 0 pushed far off the train
+    distribution."""
+    X = _fuzz_matrix(rng, n)
+    X[:, 0] = np.nan_to_num(X[:, 0]) + 6.0
+    return X
+
+
+def _http_get(port, path, timeout=30):
+    """One plain-HTTP request against the gateway's serving port;
+    returns (status_code, headers dict, body bytes)."""
+    with socket.create_connection(("127.0.0.1", port),
+                                  timeout=timeout) as s:
+        s.sendall(f"GET {path} HTTP/1.0\r\nHost: t\r\n\r\n".encode())
+        buf = b""
+        while True:
+            d = s.recv(65536)
+            if not d:
+                break
+            buf += d
+    head, _, body = buf.partition(b"\r\n\r\n")
+    lines = head.decode("latin-1").split("\r\n")
+    status = int(lines[0].split()[1])
+    headers = {}
+    for ln in lines[1:]:
+        k, _, v = ln.partition(":")
+        headers[k.strip().lower()] = v.strip()
+    return status, headers, body
+
+
+def _assert_prometheus_exposition(text):
+    """Every non-comment line is `name[{labels}] value` — the format a
+    real Prometheus scraper would accept."""
+    import re
+    pat = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*"
+                     r"(\{[a-zA-Z_][a-zA-Z0-9_]*=\"[^\"]*\""
+                     r"(,[a-zA-Z_][a-zA-Z0-9_]*=\"[^\"]*\")*\})?"
+                     r" [-+]?[0-9.eE+naif]+$")
+    lines = [ln for ln in text.splitlines() if ln.strip()]
+    assert lines
+    for ln in lines:
+        if ln.startswith("#"):
+            continue
+        assert pat.match(ln), f"not Prometheus exposition: {ln!r}"
+
+
+@pytest.mark.serving
+def test_fleet_tenants_drift_and_http_scrape(rng, bst_a, bst_b):
+    """The acceptance scenario: a 2-replica 2-tenant fleet under mixed
+    pickle/binary/HTTP traffic serves a forced-drift window; the report
+    (schema-v8-validated) carries per-tenant p99 + SLO gauges and a
+    drift section naming the injected feature, and the same data is
+    scrapeable by a plain HTTP client via GET /metrics — while the
+    pickle and binary protocols keep answering on the same port."""
+    server = bst_a.serve(replicas=2, port=0, min_bucket=64,
+                         max_batch_rows=64, deadline_ms=1.0,
+                         record_rows=512, drift_min_rows=32)
+    try:
+        server.replicas.load("alt", booster=bst_b)
+        with ServingClient("127.0.0.1", server.port, timeout=60,
+                           protocol="binary") as cb, \
+                ServingClient("127.0.0.1", server.port, timeout=60,
+                              protocol="pickle") as cp:
+            for _ in range(3):
+                cb.predict(_f32(_fuzz_matrix(rng, 48)))
+                cb.predict(_f32(_fuzz_matrix(rng, 16)), model="alt")
+                cp.predict(_f32(_fuzz_matrix(rng, 8)))
+            # baseline = the traffic above, then a drifted window
+            assert server.capture_drift_baseline("default") is True
+            for _ in range(3):
+                cb.predict(_f32(_drifted_matrix(rng, 48)))
+            rep = cb.stats()
+            text_op = cb.metrics()
+
+            assert validate_report(rep) == [], validate_report(rep)
+            assert rep["schema_version"] == 8
+            tenants = {t["model"]: t for t in rep["serving"]["tenants"]}
+            assert set(tenants) == {"default", "alt"}
+            for t in tenants.values():
+                assert t["requests"] > 0 and t["shed"] == 0
+                assert t["latency_ms"]["p99"] >= t["latency_ms"]["p50"] > 0
+                slo = t["slo"]
+                assert 0.0 <= slo["attainment"] <= 1.0
+                assert slo["p99_target_ms"] == 50.0
+                assert slo["error_budget_burn"] >= 0.0
+            drift = rep["drift"]
+            assert drift["drifted"] is True
+            assert "Column_0" in drift["top_features"]
+            assert drift["model"] == "default"
+            assert drift["window_rows"] >= 32
+
+            # one HTTP scrape of the same port — same numbers
+            status, headers, body = _http_get(server.port, "/metrics")
+            assert status == 200
+            assert headers["content-type"].startswith("text/plain")
+            assert int(headers["content-length"]) == len(body)
+            page = body.decode()
+            _assert_prometheus_exposition(page)
+            _assert_prometheus_exposition(text_op)
+            for want in ('lgbt_serving_tenant_requests_total{model="alt"}',
+                         'lgbt_serving_tenant_latency_p99_ms'
+                         '{model="default"}',
+                         'lgbt_serving_tenant_slo_attainment',
+                         "lgbt_serving_drift_drifted 1",
+                         'lgbt_serving_drift_feature_psi'
+                         '{feature="Column_0"}'):
+                assert want in page, want
+                assert want in text_op, want
+            status, _, body = _http_get(server.port, "/nope")
+            assert status == 404 and b"/metrics" in body
+            # HEAD: headers only, no body
+            with socket.create_connection(("127.0.0.1", server.port),
+                                          timeout=30) as s:
+                s.sendall(b"HEAD /metrics HTTP/1.0\r\n\r\n")
+                buf = b""
+                while True:
+                    d = s.recv(65536)
+                    if not d:
+                        break
+                    buf += d
+            hd, _, body = buf.partition(b"\r\n\r\n")
+            assert hd.split(b"\r\n")[0] == b"HTTP/1.0 200 OK"
+            assert body == b""
+
+            # all three protocols still answer after the scrapes
+            Xt = _f32(_fuzz_matrix(rng, 9))
+            np.testing.assert_allclose(np.asarray(cb.predict(Xt)).ravel(),
+                                       np.asarray(cp.predict(Xt)).ravel(),
+                                       rtol=0, atol=0)
+    finally:
+        server.stop()
+
+
+def test_tenant_slo_isolation():
+    """A slow tenant burns its own error budget; the fast tenant's
+    attainment stays 1.0 (per-tenant histograms, not a shared one)."""
+    from lightgbm_tpu.serving.batcher import ServingStats
+
+    stats = ServingStats(slo_p99_ms=50.0, slo_target=0.99)
+    for _ in range(200):
+        stats.record_tenant_request("fast", 1.0)
+        stats.record_tenant_request("slow", 200.0)
+    stats.record_tenant_shed("slow")
+    stats.record_tenant_error("slow")
+    tenants = {t["model"]: t for t in stats.tenants_section()}
+    fast, slow = tenants["fast"], tenants["slow"]
+    assert fast["latency_ms"]["p99"] < 5.0 < 50.0 < \
+        slow["latency_ms"]["p99"]
+    assert fast["slo"]["attainment"] == 1.0
+    assert fast["slo"]["error_budget_burn"] == 0.0
+    assert slow["slo"]["attainment"] == 0.0
+    assert slow["slo"]["error_budget_burn"] == pytest.approx(100.0)
+    assert slow["shed"] == 1 and slow["errors"] == 1
+    assert fast["shed"] == 0 and fast["errors"] == 0
+
+
+@pytest.mark.serving
+def test_fleet_stats_out_daemon_writes_tenants(rng, bst_a, tmp_path):
+    """The stats-out daemon's periodic snapshots carry the tenant and
+    drift sections and validate against the checked-in schema."""
+    import json
+
+    out = tmp_path / "fleet_stats.json"
+    server = bst_a.serve(replicas=1, port=0, min_bucket=64,
+                         max_batch_rows=64, deadline_ms=1.0,
+                         record_rows=256, stats_out=str(out),
+                         stats_interval_s=0.1)
+    try:
+        with ServingClient("127.0.0.1", server.port, timeout=60,
+                           protocol="binary") as c:
+            c.predict(_f32(_fuzz_matrix(rng, 64)))
+            assert server.capture_drift_baseline() is True
+            c.predict(_f32(_drifted_matrix(rng, 64)))
+            deadline = time.time() + 30
+            rep = None
+            while time.time() < deadline:
+                if out.exists():
+                    try:
+                        rep = json.loads(out.read_text())
+                    except ValueError:   # mid-replace read
+                        rep = None
+                    if rep and rep.get("drift") and \
+                            rep["serving"].get("tenants"):
+                        break
+                time.sleep(0.05)
+    finally:
+        server.stop()
+    assert rep is not None and validate_report(rep) == []
+    assert rep["serving"]["tenants"][0]["model"] == "default"
+    assert rep["drift"]["drifted"] is True
+
+
+@pytest.mark.serving
+def test_control_plane_errors_count_against_tenant(rng, bst_a):
+    """A failed control op (bad swap payload) lands in the tenant's
+    error counter, so the error-budget math sees control-plane
+    failures — not only predict failures."""
+    server = bst_a.serve(replicas=1, port=0, min_bucket=64,
+                         max_batch_rows=64, deadline_ms=1.0)
+    try:
+        with ServingClient("127.0.0.1", server.port, timeout=60,
+                           protocol="binary") as c:
+            c.predict(_f32(_fuzz_matrix(rng, 8)))
+            with pytest.raises(RuntimeError):
+                c.swap("garbage", model="default")
+            rep = c.stats()
+    finally:
+        server.stop()
+    assert validate_report(rep) == []
+    tenants = {t["model"]: t for t in rep["serving"]["tenants"]}
+    assert tenants["default"]["errors"] >= 1
+    assert rep["serving"]["errors"] >= 1
+
+
 @pytest.mark.analysis
 def test_lint_covers_selector_accept_path():
     """LGB001 treats setblocking(False) like settimeout on the gateway's
