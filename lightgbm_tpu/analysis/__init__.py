@@ -7,13 +7,26 @@ fixed by hand (K collectives per stall event, corrupt length prefixes
 driving multi-GB allocs, recompiles on every new row count) at ANALYSIS
 time instead of in chaos tests or on-device profiles.
 
-Four passes, one gate:
+Six passes, one gate:
 
   * ``jaxpr_lint``  — trace the wave tree step, the sharded learners and
     the serving binner/traversal programs; walk the closed jaxprs and
     enforce per-program collective-site budgets (``budgets.json``), no
     host callbacks in hot loops, no f64 when x64 is off, and a
-    baked-constant size ceiling.
+    baked-constant size ceiling.  Each program is traced ONCE per gate
+    run and the trace is shared with the spmd pass.
+  * ``spmd``        — the SPMD safety analyzer: per-program collective
+    ORDER pinned against ``sequences.json`` (counts alone miss a moved
+    collective — the silent-pod-hang class), order equality across mesh
+    factorizations of the same mode, rank-divergent host control flow
+    around collectives (LGB008), and blocking calls on the fleet
+    gateway's selector thread (LGB010).
+  * ``donation``    — use-after-donate: every ``donate_argnums`` site
+    mapped to its donated bindings, reads-after-call and aliased
+    donations flagged (LGB009); plus a runtime assert that each
+    designated donating program's compiled HLO actually carries
+    input->output aliasing (donation silently dropped = the PR 12 HBM
+    win silently lost).
   * ``recompile``   — fingerprint jit caches; fail when a warmed serving
     bucket or training step retraces.
   * ``races``       — AST lock-acquisition graph across the serving +
@@ -35,8 +48,8 @@ run anywhere.
 
 from .common import (Finding, apply_allowlist, build_report, is_allowed,
                      load_allowlist, load_budgets, load_schema,
-                     validate_findings_report)
+                     load_sequences, validate_findings_report)
 
 __all__ = ["Finding", "apply_allowlist", "build_report", "is_allowed",
            "load_allowlist", "load_budgets", "load_schema",
-           "validate_findings_report"]
+           "load_sequences", "validate_findings_report"]

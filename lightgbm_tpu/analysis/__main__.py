@@ -1,13 +1,20 @@
 """The analysis gate: ``python -m lightgbm_tpu.analysis [--json out.json]``.
 
-Runs the four passes (lint, races, jaxpr, recompile), prints a summary,
-optionally writes the schema-validated JSON findings report, and exits
-non-zero when any unsuppressed finding remains — so it can run as a
-pre-merge check.
+Runs the six passes (lint, races, spmd, donation, jaxpr, recompile),
+prints a summary, optionally writes the schema-validated JSON findings
+report, and exits non-zero when any unsuppressed finding remains — so it
+can run as a pre-merge check.
 
-``--dump-budgets`` re-derives ``budgets.json`` from the currently traced
-programs (run it when a reviewed learner change legitimately moves a
-collective count, and commit the diff).
+The traced-program passes share ONE trace cache: each budgeted program
+is traced exactly once per gate run and consumed by both the jaxpr
+budget lints and the spmd collective-order checks; per-program trace
+seconds land in the JSON report.  ``--programs <glob>`` narrows the
+traced set for scoped CI/local runs (AST passes always run in full).
+
+``--dump-budgets`` re-derives ``budgets.json`` and ``--dump-sequences``
+re-derives ``sequences.json`` from the currently traced programs (run
+them when a reviewed learner change legitimately moves a collective
+count or reorders the schedule, and commit the diff).
 """
 
 from __future__ import annotations
@@ -18,11 +25,14 @@ import os
 import sys
 from typing import Dict, List
 
-from . import jaxpr_lint, lint, races, recompile
-from .common import (BUDGETS_PATH, Finding, build_report,
+from . import donation, jaxpr_lint, lint, races, recompile, spmd
+from .common import (BUDGETS_PATH, SEQUENCES_PATH, Finding, build_report,
                      validate_findings_report)
 
-ALL_PASSES = ("lint", "races", "jaxpr", "recompile")
+ALL_PASSES = ("lint", "races", "spmd", "donation", "jaxpr", "recompile")
+
+#: passes that need a live jax backend (the rest are pure-AST)
+_JAX_PASSES = frozenset({"spmd", "donation", "jaxpr", "recompile"})
 
 
 def _ensure_cpu_platform() -> None:
@@ -53,13 +63,24 @@ def main(argv=None) -> int:
         prog="python -m lightgbm_tpu.analysis",
         description="Static program-invariant analysis gate")
     ap.add_argument("--json", metavar="PATH", default="",
-                    help="write the schema-validated findings report here")
+                    help="write the schema-validated findings report here "
+                         "(convention: reports/analysis_report.json, next "
+                         "to the observability report artifacts)")
     ap.add_argument("--passes", default=",".join(ALL_PASSES),
-                    help="comma list from {lint,races,jaxpr,recompile}")
+                    help="comma list from "
+                         "{lint,races,spmd,donation,jaxpr,recompile}")
+    ap.add_argument("--programs", metavar="GLOB", default="",
+                    help="fnmatch glob narrowing the traced-program set "
+                         "(jaxpr budgets + spmd sequences + donation HLO "
+                         "asserts) for scoped runs, e.g. 'wave_sharded*'")
     ap.add_argument("--dump-budgets", metavar="PATH", nargs="?",
                     const=BUDGETS_PATH, default="",
                     help="trace the program set and (re)write budgets.json "
                          "instead of gating")
+    ap.add_argument("--dump-sequences", metavar="PATH", nargs="?",
+                    const=SEQUENCES_PATH, default="",
+                    help="trace the program set and (re)write "
+                         "sequences.json instead of gating")
     ap.add_argument("--quiet", action="store_true")
     args = ap.parse_args(argv)
 
@@ -72,32 +93,51 @@ def main(argv=None) -> int:
         if not args.quiet:
             print(f"[lightgbm_tpu.analysis] {msg}", flush=True)
 
-    if args.dump_budgets or "jaxpr" in selected or "recompile" in selected:
+    dumping = args.dump_budgets or args.dump_sequences
+    if dumping or (_JAX_PASSES & set(selected)):
         _ensure_cpu_platform()
 
-    if args.dump_budgets:
-        log("tracing the program set to derive budgets ...")
-        _, stats, skipped = jaxpr_lint.run(budgets={"max_const_bytes": 0,
-                                                    "programs": {}})
-        if skipped:
+    if dumping:
+        log("tracing the program set to derive pinned artifacts ...")
+        traced = jaxpr_lint.trace_programs()
+        if traced.skipped:
             log(f"WARNING: programs not traced on this platform: "
-                f"{sorted(skipped)} — budgets incomplete")
+                f"{sorted(traced.skipped)} — pinned artifacts incomplete")
             return 1
-        payload = jaxpr_lint.budgets_from_stats(stats)
-        with open(args.dump_budgets, "w") as fh:
-            json.dump(payload, fh, indent=2)
-            fh.write("\n")
-        log(f"wrote {args.dump_budgets}")
-        for name, st in sorted(stats.items()):
-            log(f"  {name}: collectives={st['collectives']} "
-                f"const_bytes={st['const_bytes']}")
+        if args.dump_budgets:
+            stats = {name: jaxpr_lint.collect_stats(closed)
+                     for name, closed in traced.closed.items()}
+            payload = jaxpr_lint.budgets_from_stats(stats)
+            with open(args.dump_budgets, "w") as fh:
+                json.dump(payload, fh, indent=2)
+                fh.write("\n")
+            log(f"wrote {args.dump_budgets}")
+            for name, st in sorted(stats.items()):
+                log(f"  {name}: collectives={st['collectives']} "
+                    f"const_bytes={st['const_bytes']}")
+        if args.dump_sequences:
+            spmd.dump_sequences(traced, args.dump_sequences)
+            log(f"wrote {args.dump_sequences}")
+            for name, closed in sorted(traced.closed.items()):
+                seq = spmd.extract_sequence(closed)
+                log(f"  {name}: {len(seq)} collective(s) in order")
         return 0
 
     findings: List[Finding] = []
     pass_results: Dict[str, Dict[str, object]] = {}
+    n = len(selected)
+    step = iter(range(1, n + 1))
+
+    # one trace per program, shared by the spmd order checks and the
+    # jaxpr budget lints (satellite: the gate must not re-trace)
+    traced = None
+    if "spmd" in selected or "jaxpr" in selected:
+        log("tracing the program set once (shared by spmd + jaxpr) ...")
+        traced = jaxpr_lint.trace_programs(glob=args.programs or None)
 
     if "lint" in selected:
-        log("pass 1/4: AST repo lint + report schema drift ...")
+        log(f"pass {next(step)}/{n}: AST repo lint + report schema "
+            "drift ...")
         kept, suppressed = lint.run()
         # LGB006: the emitted telemetry/serving reports vs schema.json —
         # drift (a section key without a schema property, or a report the
@@ -113,32 +153,62 @@ def main(argv=None) -> int:
             "suppressed": len(suppressed) + len(drift_sup)}
 
     if "races" in selected:
-        log("pass 2/4: lock-order race detector ...")
+        log(f"pass {next(step)}/{n}: lock-order race detector ...")
         kept, suppressed = races.run()
         findings.extend(kept)
         pass_results["races"] = {
             "status": "findings" if kept else "ok",
             "findings": len(kept), "suppressed": len(suppressed)}
 
+    if "spmd" in selected:
+        log(f"pass {next(step)}/{n}: SPMD safety — rank-divergence "
+            "(LGB008), event-loop blocking (LGB010), collective-order "
+            "pins ...")
+        kept, suppressed = spmd.run(traced=traced)
+        findings.extend(kept)
+        pass_results["spmd"] = {
+            "status": "findings" if kept else "ok",
+            "findings": len(kept), "suppressed": len(suppressed)}
+
+    if "donation" in selected:
+        log(f"pass {next(step)}/{n}: use-after-donate (LGB009) + HLO "
+            "donation-liveness asserts (this compiles the donating "
+            "programs) ...")
+        import fnmatch
+        hlo_names = [p for p in donation.DONATING_PROGRAMS
+                     if not args.programs
+                     or fnmatch.fnmatch(p, args.programs)]
+        kept, suppressed, hlo_status = donation.run(
+            with_hlo=bool(hlo_names), hlo_programs=hlo_names)
+        findings.extend(kept)
+        pass_results["donation"] = {
+            "status": "findings" if kept else "ok",
+            "findings": len(kept), "suppressed": len(suppressed),
+            "detail": "; ".join(f"{k}={v}" for k, v in
+                                sorted(hlo_status.items()))
+            or f"hlo asserts not selected by --programs {args.programs!r}"}
+
     if "jaxpr" in selected:
-        log("pass 3/4: traced-program lints (this traces the tree "
-            "programs; no compilation) ...")
-        fs, stats, skipped = jaxpr_lint.run()
+        log(f"pass {next(step)}/{n}: traced-program lints (no "
+            "compilation) ...")
+        fs, stats, skipped = jaxpr_lint.run(traced=traced)
         findings.extend(fs)
         pass_results["jaxpr"] = {
             "status": "findings" if fs else "ok",
             "findings": len(fs),
             "programs": {name: {"collectives": st["collectives"],
                                 "const_bytes": st["const_bytes"],
-                                "eqns": st["eqns"]}
+                                "eqns": st["eqns"],
+                                "trace_seconds": round(
+                                    traced.seconds.get(name, 0.0), 3)}
                          for name, st in stats.items()},
             "detail": ("skipped: " + "; ".join(
                 f"{k} ({v})" for k, v in sorted(skipped.items()))
                 if skipped else "all programs traced")}
 
     if "recompile" in selected:
-        log("pass 4/4: recompile sentinel (compiles and runs a tiny "
-            "train + serving warm path) ...")
+        log(f"pass {next(step)}/{n}: recompile sentinel (compiles and "
+            "runs a tiny train + serving warm path) ...")
         fs, detail, skip_reason = recompile.run()
         findings.extend(fs)
         pass_results["recompile"] = {
@@ -150,8 +220,7 @@ def main(argv=None) -> int:
 
     report = build_report(pass_results, findings,
                           environment=_environment()
-                          if ("jaxpr" in selected or
-                              "recompile" in selected) else None)
+                          if (_JAX_PASSES & set(selected)) else None)
     errs = validate_findings_report(report)
     if errs:
         log("INTERNAL: findings report violates analysis/schema.json: "
@@ -159,6 +228,9 @@ def main(argv=None) -> int:
         return 2
 
     if args.json:
+        d = os.path.dirname(args.json)
+        if d:
+            os.makedirs(d, exist_ok=True)
         with open(args.json + ".tmp", "w") as fh:
             json.dump(report, fh, indent=2, sort_keys=True)
             fh.write("\n")

@@ -20,12 +20,13 @@ import os
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
-SCHEMA_VERSION = 1
+SCHEMA_VERSION = 2
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 SCHEMA_PATH = os.path.join(_HERE, "schema.json")
 ALLOWLIST_PATH = os.path.join(_HERE, "allowlist.json")
 BUDGETS_PATH = os.path.join(_HERE, "budgets.json")
+SEQUENCES_PATH = os.path.join(_HERE, "sequences.json")
 
 #: the package under analysis (lightgbm_tpu/) and the repo root above it
 PKG_ROOT = os.path.dirname(_HERE)
@@ -87,6 +88,15 @@ def load_budgets(path: Optional[str] = None) -> Dict[str, Any]:
     p = BUDGETS_PATH if path is None else path
     if not os.path.exists(p):
         return {"max_const_bytes": 0, "programs": {}}
+    return _load_json(p)
+
+
+def load_sequences(path: Optional[str] = None) -> Dict[str, Any]:
+    """The checked-in per-program collective-order sequences
+    (``sequences.json``, re-derivable via ``--dump-sequences``)."""
+    p = SEQUENCES_PATH if path is None else path
+    if not os.path.exists(p):
+        return {"programs": {}}
     return _load_json(p)
 
 

@@ -29,7 +29,9 @@ test suite runs with x64 on for parity tests, where f64 is legitimate.
 
 from __future__ import annotations
 
+import fnmatch
 import functools
+import time
 from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 
 from .common import Finding, load_budgets
@@ -306,12 +308,15 @@ def _trace_wave_sharded(kind: str, quant: bool = False, ndev: int = 2):
     return jax.make_jaxpr(fn)(learner.sharded_bins(), z, z, z, fmask_pad)
 
 
-def _trace_wave_sharded_2d(shape: Tuple[int, int] = (2, 2)):
+def _trace_wave_sharded_2d(shape: Tuple[int, int] = (2, 2),
+                           features: int = 8):
     """The 2-D hybrid wave tree step on a (data, feature) mesh.  The
     toy dataset's 8 padded features pack to 2 words, so feature-axis=2 is
     the word-aligned tile limit at this width (tests use wider problems
     for 2x4 shapes); the pod variant scales the DATA axis instead
-    ((4, 2) — the 2-host x 4-local virtual layout, row axis host-major)."""
+    ((4, 2) — the 2-host x 4-local virtual layout, row axis host-major).
+    ``features`` widens the toy problem for the mesh-factorization sweep
+    (spmd.py needs feature-axis=4 eligible, i.e. 16 features -> 4 words)."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
@@ -323,7 +328,7 @@ def _trace_wave_sharded_2d(shape: Tuple[int, int] = (2, 2)):
         wave2d_ineligible_reason
 
     params = dict(_BASE_PARAMS, enable_bundle=False)
-    ds = _toy_dataset(2048, 8, params)
+    ds = _toy_dataset(2048, features, params)
     mesh = make_mesh(shape=shape, axis_names=(AXIS_DATA, AXIS_FEATURE))
     cfg = Config.from_params(dict(params, tree_learner="data_feature"))
     reason = wave2d_ineligible_reason(cfg, ds.constructed, mesh)
@@ -418,21 +423,62 @@ def program_builders(need_mesh_of: int = 2
     return builders
 
 
+class TracedPrograms:
+    """One trace of the standard program set, shared across passes.
+
+    The budget, sequence-order, f64 and const-ceiling checks all walk the
+    SAME closed jaxprs — tracing each program once (seconds apiece for the
+    sharded learners) instead of once per pass is the gate's dominant
+    cost.  ``closed`` maps program name -> closed jaxpr, ``seconds`` the
+    per-program tracing wall time (surfaced in the JSON report), and
+    ``skipped`` maps untraceable programs to reasons."""
+
+    def __init__(self) -> None:
+        self.closed: Dict[str, Any] = {}
+        self.seconds: Dict[str, float] = {}
+        self.skipped: Dict[str, str] = {}
+
+
+def trace_programs(programs: Optional[Dict[str, Callable[[], Any]]] = None,
+                   glob: Optional[str] = None) -> TracedPrograms:
+    """Trace the standard program set once (``--programs <glob>`` narrows
+    the selection) and return the shared :class:`TracedPrograms` cache."""
+    if programs is None:
+        programs = program_builders()
+    tp = TracedPrograms()
+    for name in sorted(PROGRAM_FILES):
+        if glob and not fnmatch.fnmatch(name, glob):
+            tp.skipped[name] = f"not selected by --programs {glob!r}"
+            continue
+        builder = programs.get(name)
+        if builder is None:
+            tp.skipped[name] = "not traceable on this platform " \
+                "(needs a multi-device mesh)"
+            continue
+        t0 = time.perf_counter()
+        tp.closed[name] = builder()
+        tp.seconds[name] = time.perf_counter() - t0
+    return tp
+
+
 def run(budgets: Optional[Dict[str, Any]] = None,
         programs: Optional[Dict[str, Callable[[], Any]]] = None,
-        x64_off: Optional[bool] = None):
-    """Trace the standard program set and lint each against its budget.
+        x64_off: Optional[bool] = None,
+        traced: Optional[TracedPrograms] = None):
+    """Lint the standard program set against its budgets.
 
     Returns ``(findings, program_stats, skipped)`` where ``program_stats``
     maps program name to its :func:`collect_stats` output (the input for
     ``--dump-budgets``) and ``skipped`` maps missing programs to reasons.
+    ``traced`` reuses an existing :func:`trace_programs` cache instead of
+    re-tracing (the gate shares one cache with the sequence pass).
     """
     import jax
 
     if budgets is None:
         budgets = load_budgets()
-    if programs is None:
-        programs = program_builders()
+    if traced is None:
+        traced = trace_programs(programs)
     if x64_off is None:
         x64_off = not jax.config.jax_enable_x64
     max_const = int(budgets.get("max_const_bytes", 0))
@@ -440,14 +486,8 @@ def run(budgets: Optional[Dict[str, Any]] = None,
 
     findings: List[Finding] = []
     stats: Dict[str, Dict[str, Any]] = {}
-    skipped: Dict[str, str] = {}
-    for name in sorted(PROGRAM_FILES):
-        builder = programs.get(name)
-        if builder is None:
-            skipped[name] = "not traceable on this platform " \
-                "(needs a multi-device mesh)"
-            continue
-        closed = builder()
+    skipped: Dict[str, str] = dict(traced.skipped)
+    for name, closed in sorted(traced.closed.items()):
         fs, st = lint_program(name, closed, prog_budgets.get(name, {}),
                               max_const, x64_off)
         findings.extend(fs)

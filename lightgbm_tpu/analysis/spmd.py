@@ -1,0 +1,539 @@
+"""SPMD safety analyzer: collective-order pinning + rank-divergence +
+event-loop blocking lints.
+
+The reference trains multi-machine GBDTs over a FIXED Allreduce /
+ReduceScatter / Allgather schedule (`src/network`); the cardinal SPMD
+invariant is that every rank issues the same collectives in the same
+order — a divergence is a silent cluster hang, not an error.  The
+budgets pass (`jaxpr_lint.py`) pins collective *counts* per program;
+this module pins the rest of the invariant:
+
+  * **collective-order pinning** — walk the already-traced closed jaxprs
+    of every budgeted program and extract the ordered collective
+    *sequence* ``(primitive, axis_names, shard shape, dtype)``; check it
+    against the checked-in ``sequences.json`` (re-derivable with
+    ``--dump-sequences``, the budgets.json workflow).  A collective that
+    MOVES — same site count, different order — is invisible to budgets
+    but still deadlocks a pod when only some ranks take the new path.
+  * **cross-factorization order diff** — the same mode traced at
+    different mesh factorizations (data at 2/4/8 devices; the 2-D
+    hybrid at 1x4 / 2x2 / 4x1 and the (4,2) pod layout) must issue the
+    identical ``(primitive, axes)`` order: shard widths may change with
+    the mesh, the schedule may not.  This pins host-transparency
+    structurally — the property PR 13's pod emulation only sampled.
+  * **LGB008 rank-divergence** — AST pass over ``parallel/``, ``io/``
+    and ``boosting/``: host control flow conditioned on rank identity
+    (``process_index()``, ``rank ==``, heartbeat / dead-rank results)
+    that dominates a collective or net op on only one branch is exactly
+    the deadlock class elastic recovery (ROADMAP item 2) will
+    introduce.  Vetted sites (the SocketNet star protocol, root-only
+    lagged GC) carry ``allowlist.json`` entries with reasons.
+  * **LGB010 event-loop blocking** — the fleet gateway's selector
+    thread (and the batcher ``_done`` callbacks it hands out) must
+    never block: no ``time.sleep``, no ``block_until_ready``, no
+    unbounded frame recv, and every socket op must sit in the
+    non-blocking idiom (an enclosing ``BlockingIOError`` handler — the
+    gateway's sockets are all ``setblocking(False)``).
+
+The AST passes stay import-light (no jax); the sequence checks consume
+the shared :class:`jaxpr_lint.TracedPrograms` cache, so the gate traces
+each program exactly once for budgets + sequences + f64 + const rules.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Set, \
+    Tuple
+
+from .common import Finding, PKG_ROOT, apply_allowlist, load_allowlist, \
+    load_sequences, rel_file
+from .jaxpr_lint import COLLECTIVE_PRIMS, PROGRAM_FILES, iter_eqns
+
+# -- collective-order sequences ----------------------------------------------
+
+
+def extract_sequence(closed_jaxpr) -> List[Dict[str, Any]]:
+    """The ordered collective sequence of one traced program: for every
+    collective eqn (in trace order, recursing into while/cond/scan/pjit
+    bodies) the ``(primitive, axis_names, shard shape, dtype)`` tuple.
+    Shapes are the first operand's per-device aval — what actually hits
+    the wire under shard_map."""
+    seq: List[Dict[str, Any]] = []
+    for eqn in iter_eqns(closed_jaxpr.jaxpr):
+        name = eqn.primitive.name
+        if name not in COLLECTIVE_PRIMS:
+            continue
+        axes = eqn.params.get("axes", eqn.params.get("axis_name", ()))
+        if not isinstance(axes, (list, tuple)):
+            axes = (axes,)
+        shape: List[int] = []
+        dtype = ""
+        for iv in eqn.invars:
+            aval = getattr(iv, "aval", None)
+            if getattr(aval, "shape", None) is not None:
+                shape = [int(d) for d in aval.shape]
+                dtype = str(getattr(aval, "dtype", ""))
+                break
+        seq.append({"prim": name, "axes": [str(a) for a in axes],
+                    "shape": shape, "dtype": dtype})
+    return seq
+
+
+def order_signature(seq: Sequence[Dict[str, Any]]
+                    ) -> List[Tuple[str, Tuple[str, ...]]]:
+    """The factorization-invariant view of a sequence: ``(primitive,
+    axis_names)`` in order, shard widths and dtypes dropped — what must
+    agree across mesh shapes of the same mode."""
+    return [(e["prim"], tuple(e["axes"])) for e in seq]
+
+
+def _fmt_entry(e: Dict[str, Any]) -> str:
+    return "%s@%s %s%s" % (e["prim"], ",".join(e["axes"]), e["dtype"],
+                           list(e["shape"]))
+
+
+def sequences_from(traced) -> Dict[str, Any]:
+    """The ``sequences.json`` payload pinning the CURRENT collective
+    order of every traced program (``--dump-sequences``).  Reordering a
+    collective is a deliberate, reviewed act — same contract as
+    ``budgets_from_stats``."""
+    return {
+        "_comment": "Per-program ordered collective sequences (primitive, "
+                    "axis names, per-device shard shape, dtype) extracted "
+                    "from the traced programs. Every rank must issue these "
+                    "in exactly this order; a change that moves or "
+                    "reshapes a collective MUST regenerate this file "
+                    "(python -m lightgbm_tpu.analysis --dump-sequences) "
+                    "in the same commit, with the why in the commit "
+                    "message.",
+        "programs": {
+            name: extract_sequence(closed)
+            for name, closed in sorted(traced.closed.items())
+        },
+    }
+
+
+def check_sequences(traced, sequences: Optional[Dict[str, Any]] = None
+                    ) -> List[Finding]:
+    """Diff every traced program's collective sequence against the
+    checked-in pin.  Order, axis names, shard shape and dtype must all
+    match exactly — rule ``collective-order``."""
+    if sequences is None:
+        sequences = load_sequences()
+    pinned = sequences.get("programs", {})
+    findings: List[Finding] = []
+    for name, closed in sorted(traced.closed.items()):
+        file = PROGRAM_FILES.get(name, "lightgbm_tpu")
+        want = pinned.get(name)
+        got = extract_sequence(closed)
+        if want is None:
+            findings.append(Finding(
+                "spmd", "collective-order", file,
+                f"program {name!r} has no pinned sequence in "
+                f"analysis/sequences.json — run --dump-sequences and "
+                f"commit the diff", symbol=name))
+            continue
+        if got == want:
+            continue
+        detail = _first_divergence(want, got)
+        findings.append(Finding(
+            "spmd", "collective-order", file,
+            f"program {name!r} collective order diverges from "
+            f"analysis/sequences.json ({detail}) — every rank must issue "
+            f"the same collectives in the same order; a reviewed change "
+            f"must regenerate sequences.json in the same commit",
+            symbol=name))
+    return findings
+
+
+def _first_divergence(want: Sequence[Dict[str, Any]],
+                      got: Sequence[Dict[str, Any]]) -> str:
+    if len(want) != len(got):
+        return f"pinned {len(want)} collective(s), traced {len(got)}"
+    for i, (w, g) in enumerate(zip(want, got)):
+        if w != g:
+            return (f"site {i}: pinned {_fmt_entry(w)}, "
+                    f"traced {_fmt_entry(g)}")
+    return "sequences differ"
+
+
+#: mode -> the budgeted programs that are the SAME program at different
+#: mesh factorizations; their (primitive, axes) order must be identical
+FACTORIZATION_GROUPS = {
+    "data": ("wave_sharded_data", "wave_sharded_data_pod"),
+    "data_feature": ("wave_sharded_2d", "wave_sharded_2d_pod"),
+}
+
+
+def cross_factorization_findings(traced, groups: Optional[Dict[str, Tuple[
+        str, ...]]] = None) -> List[Finding]:
+    """Rule ``collective-order-factorization``: within each mode, every
+    traced factorization must issue the identical ``(primitive, axes)``
+    order.  Shard widths differ per mesh shape (the budgets pass pins
+    bytes); ORDER differing means the program is not host-transparent —
+    some layouts would enter a collective other layouts never reach."""
+    if groups is None:
+        groups = FACTORIZATION_GROUPS
+    findings: List[Finding] = []
+    for mode, names in sorted(groups.items()):
+        have = [(n, order_signature(extract_sequence(traced.closed[n])))
+                for n in names if n in traced.closed]
+        if len(have) < 2:
+            continue
+        ref_name, ref_sig = have[0]
+        for name, sig in have[1:]:
+            if sig == ref_sig:
+                continue
+            detail = "differing length" if len(sig) != len(ref_sig) else \
+                next(f"site {i}: {a} vs {b}"
+                     for i, (a, b) in enumerate(zip(ref_sig, sig))
+                     if a != b)
+            findings.append(Finding(
+                "spmd", "collective-order-factorization",
+                PROGRAM_FILES.get(name, "lightgbm_tpu"),
+                f"mode {mode!r}: programs {ref_name!r} and {name!r} are "
+                f"the same learner at different mesh factorizations but "
+                f"issue different collective orders ({detail}) — the "
+                f"schedule must be mesh-shape-invariant", symbol=name))
+    return findings
+
+
+# -- LGB008: rank-divergent control flow around collectives -------------------
+
+#: the default LGB008 analysis set (ISSUE: the layers elastic recovery
+#: will touch)
+RANK_DIRS = ("parallel", "io", "boosting")
+
+#: call names (attribute suffixes) that ARE collective / net ops: the
+#: host-side net seams (SocketNet / DistributedNet / LoopbackNet), the
+#: KV-store ops DistributedNet rides, and the jax collectives themselves
+#: (host code constructing a rank-conditional traced collective)
+_COLLECTIVE_CALLS = frozenset({
+    "allgather", "sync_min", "sync_max", "heartbeat", "barrier",
+    "_send_msg", "_recv_msg", "_recv_deadline", "_abort_survivors",
+    "key_value_set_bytes", "blocking_key_value_get_bytes",
+    "key_value_delete", "wait_at_barrier",
+}) | COLLECTIVE_PRIMS
+
+#: identifier fragments that mean "this condition depends on rank
+#: identity or liveness results" — `self.rank`, `rank == 0`,
+#: `jax.process_index()`, heartbeat / dead-rank verdicts
+_RANK_TOKENS = ("process_index", "dead_rank", "heartbeat", "is_master",
+                "missing_rank")
+
+
+def _is_rank_conditioned(test: ast.expr) -> bool:
+    for node in ast.walk(test):
+        if isinstance(node, ast.Name) and node.id == "rank":
+            return True
+        if isinstance(node, ast.Attribute) and node.attr == "rank":
+            return True
+        if isinstance(node, (ast.Name, ast.Attribute)):
+            ident = node.id if isinstance(node, ast.Name) else node.attr
+            if any(t in ident for t in _RANK_TOKENS):
+                return True
+    return False
+
+
+def _collective_calls_in(nodes: Iterable[ast.AST]) -> Set[str]:
+    out: Set[str] = set()
+    for root in nodes:
+        for node in ast.walk(root):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            name = f.attr if isinstance(f, ast.Attribute) else (
+                f.id if isinstance(f, ast.Name) else "")
+            if name in _COLLECTIVE_CALLS:
+                out.add(name)
+    return out
+
+
+def _rank_scope_stack(tree: ast.Module) -> List[Tuple[str, ast.AST]]:
+    """(qualname, function node) for every function, classes joined in."""
+    out: List[Tuple[str, ast.AST]] = []
+
+    def visit(node: ast.AST, stack: List[str]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out.append((".".join(stack + [child.name]), child))
+                visit(child, stack + [child.name])
+            elif isinstance(child, ast.ClassDef):
+                visit(child, stack + [child.name])
+            else:
+                visit(child, stack)
+
+    visit(tree, [])
+    return out
+
+
+def rank_divergence_file(path: str) -> List[Finding]:
+    """LGB008 findings for one file (no allowlist applied)."""
+    with open(path) as fh:
+        tree = ast.parse(fh.read(), filename=path)
+    rf = rel_file(path)
+    findings: List[Finding] = []
+    for qualname, fn in _rank_scope_stack(tree):
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.If, ast.While)):
+                body, orelse = node.body, getattr(node, "orelse", [])
+            elif isinstance(node, ast.IfExp):
+                body, orelse = [node.body], [node.orelse]
+            else:
+                continue
+            if not _is_rank_conditioned(node.test):
+                continue
+            in_body = _collective_calls_in(body)
+            in_else = _collective_calls_in(orelse)
+            if in_body == in_else:
+                continue       # symmetric (or no) collectives: every rank
+            diverging = sorted(in_body ^ in_else)
+            findings.append(Finding(
+                "spmd", "LGB008-rank-divergence", rf,
+                f"rank-conditioned branch dominates collective/net op(s) "
+                f"{diverging} on only one side — ranks taking different "
+                f"paths around a collective is a silent cluster hang; "
+                f"make the schedule rank-symmetric or allowlist this "
+                f"vetted site with a reason",
+                line=node.lineno, symbol=qualname))
+    return findings
+
+
+def rank_divergence(paths: Optional[Sequence[str]] = None
+                    ) -> List[Finding]:
+    """LGB008 over ``parallel/``, ``io/``, ``boosting/`` (no allowlist
+    applied — :func:`run` does that)."""
+    if paths is None:
+        paths = []
+        for d in RANK_DIRS:
+            root = os.path.join(PKG_ROOT, d)
+            for dirpath, dirnames, filenames in os.walk(root):
+                dirnames[:] = sorted(x for x in dirnames
+                                     if x != "__pycache__")
+                paths.extend(os.path.join(dirpath, f)
+                             for f in sorted(filenames)
+                             if f.endswith(".py"))
+    findings: List[Finding] = []
+    for p in paths:
+        findings.extend(rank_divergence_file(p))
+    return findings
+
+
+# -- LGB010: blocking calls on the gateway's selector thread ------------------
+
+#: the event-loop analysis set: the selector gateway (loop thread +
+#: the _done callbacks it hands to batcher workers)
+LOOP_FILES = (os.path.join("serving", "fleet", "gateway.py"),)
+
+#: the loop entry point: everything reachable from here via self-calls
+#: runs on the selector thread
+_LOOP_ENTRY = "_loop"
+
+#: socket methods that park the calling thread unless the socket is
+#: non-blocking (the gateway idiom: an enclosing BlockingIOError handler)
+_SOCKET_OPS = frozenset({"recv", "recv_into", "accept", "send", "sendall",
+                         "connect", "makefile"})
+
+#: calls that block unconditionally — never allowed on the loop thread
+_HARD_BLOCKERS = {
+    "time.sleep": "time.sleep parks the selector thread",
+    "block_until_ready": "block_until_ready syncs on device work",
+    "_recv_msg": "length-prefixed frame recv blocks until a full frame",
+    "recv_frame": "length-prefixed frame recv blocks until a full frame",
+    "create_connection": "blocking connect",
+}
+
+
+def _loop_callables(tree: ast.Module) -> Dict[str, ast.AST]:
+    """name -> function node for every method of every class plus nested
+    callback defs, with nested defs keyed ``outer.<name>``."""
+    out: Dict[str, ast.AST] = {}
+
+    def visit(node: ast.AST, prefix: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                key = f"{prefix}.{child.name}" if prefix else child.name
+                out[key] = child
+                visit(child, key)
+            elif isinstance(child, ast.ClassDef):
+                visit(child, "")
+            else:
+                visit(child, prefix)
+
+    visit(tree, "")
+    return out
+
+
+def _thread_targets(fn: ast.AST) -> Set[str]:
+    """Names handed to ``threading.Thread(target=...)`` inside ``fn`` —
+    those run on their OWN thread and are exempt from the loop rule."""
+    out: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            name = ""
+            f = node.func
+            if isinstance(f, ast.Attribute):
+                name = f.attr
+            elif isinstance(f, ast.Name):
+                name = f.id
+            if name != "Thread":
+                continue
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    for n in ast.walk(kw.value):
+                        if isinstance(n, ast.Name):
+                            out.add(n.id)
+                        elif isinstance(n, ast.Attribute):
+                            out.add(n.attr)
+    return out
+
+
+def _loop_closure(callables: Dict[str, ast.AST]) -> Dict[str, str]:
+    """Every callable transitively reachable from the loop entry on the
+    SAME thread -> how it got there (the call chain for the message).
+    ``self.m()`` follows methods; nested defs handed to anything OTHER
+    than threading.Thread (the batcher callback surface) are reachable
+    from their definition site."""
+    if _LOOP_ENTRY not in callables:
+        return {}
+    reach: Dict[str, str] = {_LOOP_ENTRY: _LOOP_ENTRY}
+    frontier = [_LOOP_ENTRY]
+    while frontier:
+        cur = frontier.pop()
+        fn = callables[cur]
+        exempt = _thread_targets(fn)
+        # nested callbacks defined here (minus Thread targets) run on
+        # worker threads invoked FOR the loop's request path — the
+        # batcher _done callbacks; they must obey the same no-block rule
+        for name in callables:
+            if name.startswith(cur + ".") and \
+                    name.rsplit(".", 1)[1] not in exempt and \
+                    name not in reach:
+                reach[name] = f"{reach[cur]} -> {name}"
+                frontier.append(name)
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if isinstance(f, ast.Attribute) and \
+                    isinstance(f.value, ast.Name) and f.value.id == "self":
+                callee = f.attr
+                if callee in callables and callee not in exempt and \
+                        callee not in reach:
+                    reach[callee] = f"{reach[cur]} -> {callee}"
+                    frontier.append(callee)
+    return reach
+
+
+def _in_blocking_guard(fn: ast.AST, call: ast.Call) -> bool:
+    """True when ``call`` sits inside a ``try`` whose handlers name
+    ``BlockingIOError`` — the gateway's proof that the socket op is
+    non-blocking (EAGAIN is expected and handled, never a park)."""
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Try):
+            continue
+        if not any(isinstance(sub, ast.Call) and sub is call
+                   for body in node.body for sub in ast.walk(body)):
+            continue
+        for handler in node.handlers:
+            if handler.type is None:
+                continue
+            names = handler.type.elts if isinstance(
+                handler.type, ast.Tuple) else [handler.type]
+            for n in names:
+                ident = n.id if isinstance(n, ast.Name) else \
+                    getattr(n, "attr", "")
+                if ident == "BlockingIOError":
+                    return True
+    return False
+
+
+def event_loop_blocking(paths: Optional[Sequence[str]] = None
+                        ) -> List[Finding]:
+    """LGB010 findings (no allowlist applied)."""
+    if paths is None:
+        paths = [os.path.join(PKG_ROOT, p) for p in LOOP_FILES]
+    findings: List[Finding] = []
+    for path in paths:
+        with open(path) as fh:
+            tree = ast.parse(fh.read(), filename=path)
+        rf = rel_file(path)
+        callables = _loop_callables(tree)
+        reach = _loop_closure(callables)
+        for name, chain in sorted(reach.items()):
+            fn = callables[name]
+            nested = {id(v) for k, v in callables.items()
+                      if k != name and k.startswith(name + ".")}
+
+            def own_calls(node: ast.AST):
+                for child in ast.iter_child_nodes(node):
+                    if id(child) in nested:
+                        continue
+                    if isinstance(child, ast.Call):
+                        yield child
+                    yield from own_calls(child)
+
+            for call in own_calls(fn):
+                f = call.func
+                dotted = ""
+                attr = ""
+                if isinstance(f, ast.Attribute):
+                    attr = f.attr
+                    try:
+                        dotted = ast.unparse(f)
+                    except Exception:
+                        dotted = attr
+                elif isinstance(f, ast.Name):
+                    attr = dotted = f.id
+                why = _HARD_BLOCKERS.get(dotted) or \
+                    _HARD_BLOCKERS.get(attr)
+                if why is not None:
+                    findings.append(Finding(
+                        "spmd", "LGB010-event-loop-blocking", rf,
+                        f"{dotted}() on the selector thread ({chain}): "
+                        f"{why} — the event loop must never block",
+                        line=call.lineno, symbol=name))
+                    continue
+                if attr in _SOCKET_OPS and isinstance(f, ast.Attribute):
+                    if attr in ("sendall", "connect", "makefile") or \
+                            not _in_blocking_guard(fn, call):
+                        findings.append(Finding(
+                            "spmd", "LGB010-event-loop-blocking", rf,
+                            f"{dotted}() on the selector thread ({chain}) "
+                            f"without a BlockingIOError guard — a "
+                            f"blocking socket op parks the whole "
+                            f"gateway; use the non-blocking idiom",
+                            line=call.lineno, symbol=name))
+    return findings
+
+
+# -- pass entry ---------------------------------------------------------------
+
+def run(rank_paths: Optional[Sequence[str]] = None,
+        loop_paths: Optional[Sequence[str]] = None,
+        allowlist: Optional[Sequence[dict]] = None,
+        traced=None, sequences: Optional[Dict[str, Any]] = None):
+    """The spmd gate pass: LGB008 + LGB010 (AST, always) plus the
+    sequence-order checks when a :class:`jaxpr_lint.TracedPrograms`
+    cache is supplied.  Returns ``(findings, suppressed)``."""
+    if allowlist is None:
+        allowlist = load_allowlist()
+    findings = rank_divergence(rank_paths) + \
+        event_loop_blocking(loop_paths)
+    if traced is not None:
+        findings += check_sequences(traced, sequences)
+        findings += cross_factorization_findings(traced)
+    return apply_allowlist(findings, allowlist)
+
+
+def dump_sequences(traced, path: str) -> None:
+    """Write ``sequences.json`` (the ``--dump-sequences`` payload) —
+    byte-stable: same traced programs, same bytes."""
+    payload = sequences_from(traced)
+    with open(path + ".tmp", "w") as fh:
+        json.dump(payload, fh, indent=2)
+        fh.write("\n")
+    os.replace(path + ".tmp", path)

@@ -191,9 +191,31 @@ class WaveTPUTreeLearner(CompactTPUTreeLearner):
         else:
             self._use_scan = False
             self._scan_interpret = False
-        self._jit_tree_w = (
-            jax.jit(self._train_tree_wave, donate_argnums=(1, 2))
-            if self._donate else jax.jit(self._train_tree_wave))
+        if self._donate:
+            # jax matches donated inputs to outputs by EXACT aval at
+            # num_partitions=1 (mlir._set_up_aliases) and the tree
+            # program has no f32[n_pad] output, so a bare donate_argnums
+            # here is silently dropped ("donated buffers were not
+            # usable") — the sharded learners only escape because the
+            # SPMD path routes donation through XLA's size-matching
+            # buffer_donor pass.  Bitcasting leaf_id (int32[n_pad]) out
+            # as its f32 bit-pattern gives the donated grad buffer a
+            # landing slot; train_async casts it back at the call seam.
+            # The analysis gate asserts input_output_alias in this
+            # program's compiled HLO (analysis/donation.py).
+            def _tree_w_donating(bins_p, grad, hess, bag, fmask):
+                out = self._train_tree_wave(bins_p, grad, hess, bag,
+                                            fmask)
+                leaf_f32 = jax.lax.bitcast_convert_type(out[3],
+                                                        jnp.float32)
+                return out[:3] + (leaf_f32,) + out[4:]
+
+            self._jit_tree_w = jax.jit(_tree_w_donating,
+                                       donate_argnums=(1, 2))
+            self._tree_w_bitcast = True
+        else:
+            self._jit_tree_w = jax.jit(self._train_tree_wave)
+            self._tree_w_bitcast = False
 
     def _fused_ok(self) -> bool:
         """Whether this learner runs the fused hist→subtract→fix→scan
@@ -1980,8 +2002,14 @@ class WaveTPUTreeLearner(CompactTPUTreeLearner):
                     feature_mask: Optional[jax.Array] = None):
         if feature_mask is None:
             feature_mask = jnp.ones(self.num_features, dtype=bool)
-        return self._pop_telem(self._jit_tree_w(
-            self.bins_packed(), grad, hess, bag, feature_mask))
+        out = self._jit_tree_w(
+            self.bins_packed(), grad, hess, bag, feature_mask)
+        if getattr(self, "_tree_w_bitcast", False):
+            # undo the donation landing-slot bitcast (see __init__):
+            # leaf_id rides out of the donating jit as f32 bits
+            leaf_id = jax.lax.bitcast_convert_type(out[3], jnp.int32)
+            out = out[:3] + (leaf_id,) + out[4:]
+        return self._pop_telem(out)
 
 
 def wave_transient_bytes(cfg: Config, n_pad: int, f_pad: int, b: int

@@ -254,12 +254,14 @@ class FeatureShardedWaveLearner(FeatureShardedCompactLearner,
             self.sharded_bins(), grad, hess, bag, fmask_pad))
 
     def lowered_hlo_text(self) -> str:
-        z = jnp.zeros(self.n_pad, jnp.float32)
-        self.train_async(z, z, z)
-        z = jnp.zeros(self.n_pad, jnp.float32)  # donation may consume z
+        # grad/hess are donate_argnums under _donate: each position gets
+        # its OWN buffer so the donated args never alias bag (LGB009)
+        g, h, b = (jnp.zeros(self.n_pad, jnp.float32) for _ in range(3))
+        self.train_async(g, h, b)
+        g, h, b = (jnp.zeros(self.n_pad, jnp.float32) for _ in range(3))
         fmask_pad = jnp.ones(self.f_pad, bool)
         return self._jit_tree_w.lower(
-            self.sharded_bins(), z, z, z, fmask_pad).compile().as_text()
+            self.sharded_bins(), g, h, b, fmask_pad).compile().as_text()
 
 
 def feature_sharded_eligible(cfg: Config, data: _ConstructedDataset,

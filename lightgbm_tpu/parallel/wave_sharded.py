@@ -180,13 +180,15 @@ class ShardedWaveLearner(ShardedCompactLearner, WaveTPUTreeLearner):
             self.sharded_bins(), grad, hess, bag, fmask_pad))
 
     def lowered_hlo_text(self) -> str:
+        # grad/hess are donate_argnums under _donate: each position gets
+        # its OWN buffer so the donated args never alias bag (LGB009)
         n = self.n_pad
-        z = jnp.zeros(n, jnp.float32)
-        self.train_async(z, z, z)  # build the jit
-        z = jnp.zeros(n, jnp.float32)   # donation may consume the first z
+        g, h, b = (jnp.zeros(n, jnp.float32) for _ in range(3))
+        self.train_async(g, h, b)  # build the jit
+        g, h, b = (jnp.zeros(n, jnp.float32) for _ in range(3))
         fmask_pad = jnp.ones(self.f_pad, bool)
         return self._jit_tree_w.lower(
-            self.sharded_bins(), z, z, z, fmask_pad).compile().as_text()
+            self.sharded_bins(), g, h, b, fmask_pad).compile().as_text()
 
     def exchange_probe(self):
         """The wave learner's real per-wave exchange: ONE batched

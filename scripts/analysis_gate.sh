@@ -1,0 +1,24 @@
+#!/usr/bin/env bash
+# The analysis gate as one command outside pytest: run all six passes,
+# write the schema-validated JSON report next to the observability
+# artifacts, and exit non-zero on any unsuppressed finding.
+#
+#   scripts/analysis_gate.sh                      # full gate
+#   scripts/analysis_gate.sh --programs 'wave*'   # scoped traced set
+#   ANALYSIS_REPORT=out.json scripts/analysis_gate.sh
+#
+# Extra arguments pass through to `python -m lightgbm_tpu.analysis`
+# (e.g. --passes lint,spmd,donation for a no-trace quick check).
+set -euo pipefail
+
+REPO_ROOT="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+REPORT="${ANALYSIS_REPORT:-${REPO_ROOT}/reports/analysis_report.json}"
+mkdir -p "$(dirname "${REPORT}")"
+
+cd "${REPO_ROOT}"
+JAX_PLATFORMS=cpu python -m lightgbm_tpu.analysis \
+    --json "${REPORT}" "$@"
+rc=$?
+
+echo "analysis_gate: report at ${REPORT}"
+exit "${rc}"

@@ -1,0 +1,20 @@
+"""Seeded LGB009 violations — use-after-donate and aliased donation.
+This file is ONLY an analysis-pass fixture; nothing imports it."""
+
+import jax
+
+
+class BadTrainer:
+    def __init__(self, fn):
+        self._jit_step_bad = jax.jit(fn, donate_argnums=(1, 2))
+
+    def step(self, bins, grad, hess, bag):
+        out = self._jit_step_bad(bins, grad, hess, bag)
+        # BAD: grad's buffer was donated to the call above — this read
+        # hits a deleted array (the failure surfaces asynchronously)
+        checksum = grad.sum()
+        return out, checksum
+
+    def warm(self, bins, z):
+        # BAD: the same binding at a donated AND a non-donated position
+        return self._jit_step_bad(bins, z, z, z)

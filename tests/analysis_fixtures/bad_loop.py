@@ -1,0 +1,28 @@
+"""Seeded LGB010 violations — blocking calls on a selector event-loop
+thread.  This file is ONLY an analysis-pass fixture; nothing imports
+it."""
+
+import time
+
+
+class BadGateway:
+    def __init__(self, sel, srv):
+        self._sel = sel
+        self._srv = srv
+
+    def _loop(self):
+        while True:
+            for key, _ in self._sel.select(timeout=0.25):
+                self._read_ready(key.fileobj)
+            # BAD: time.sleep parks the selector thread
+            time.sleep(0.01)
+
+    def _read_ready(self, sock):
+        # BAD: blocking recv with no BlockingIOError guard
+        data = sock.recv(65536)
+
+        def _done(result):
+            # BAD: batcher callback syncing on device work
+            result.block_until_ready()
+
+        return data, _done

@@ -1,0 +1,32 @@
+"""Seeded LGB008 violations — rank-divergent control flow around
+collectives.  This file is ONLY an analysis-pass fixture; nothing
+imports it."""
+
+import jax
+
+
+class BadNet:
+    def __init__(self, net):
+        self.net = net
+        self.rank = int(jax.process_index())
+
+    def exchange(self, payload):
+        # BAD: only rank 0 enters the allgather — every other rank
+        # blocks forever inside its next collective
+        if self.rank == 0:
+            return self.net.allgather(payload)
+        return None
+
+    def recover(self, dead_ranks, payload):
+        # BAD: heartbeat-verdict-conditioned barrier on one branch only
+        if dead_ranks:
+            self.net.barrier()
+        return payload
+
+
+def elect_root(net, payload):
+    # BAD: process_index-conditioned psum in the else branch only
+    if jax.process_index() == 0:
+        return payload
+    else:
+        return jax.lax.psum(payload, "data")

@@ -315,9 +315,16 @@ def test_gate_cli_end_to_end(tmp_path):
     rep = json.loads(out.read_text())
     assert validate_findings_report(rep) == []
     assert rep["summary"]["total"] == 0
-    assert set(rep["passes"]) == {"lint", "races", "jaxpr", "recompile"}
+    assert set(rep["passes"]) == {"lint", "races", "spmd", "donation",
+                                  "jaxpr", "recompile"}
     for name, res in rep["passes"].items():
         assert res["status"] in ("ok", "skipped"), (name, res)
     assert rep["environment"]["x64_enabled"] is False
-    # the jaxpr pass really traced the serving + training programs
-    assert "wave_serial" in rep["passes"]["jaxpr"]["programs"]
+    # the jaxpr pass really traced the serving + training programs, and
+    # the shared trace cache reported per-program timings (schema v2)
+    progs = rep["passes"]["jaxpr"]["programs"]
+    assert "wave_serial" in progs
+    assert all(p["trace_seconds"] >= 0 for p in progs.values())
+    # the donation pass proved HLO aliasing for every donating program
+    assert "aliased" in rep["passes"]["donation"]["detail"]
+    assert "missing" not in rep["passes"]["donation"]["detail"]

@@ -1,0 +1,232 @@
+"""SPMD safety analyzer (`analysis/spmd.py` + `analysis/donation.py`).
+
+Covers the four new gate passes from both sides:
+
+  * each pass demonstrably FAILS on its bad input — the seeded fixtures
+    trip LGB008 (rank-divergent collectives), LGB009 (use-after-donate
+    and aliased donation) and LGB010 (blocking calls on the selector
+    thread), and a mutated sequences.json trips the collective-order
+    pin;
+  * the current tree is GREEN — the repo's rank-gated sites are exactly
+    the vetted allowlist entries (each with a reason), the gateway loop
+    closure contains no blocking call, no donated buffer is read after
+    its call, every traced program matches its checked-in sequence, the
+    collective ORDER is identical across mesh factorizations of the
+    same mode (1x4 / 2x2 / 4x1 and the pod shapes), and each designated
+    donating program's compiled HLO carries input->output aliasing.
+"""
+
+import copy
+import json
+import os
+
+import pytest
+
+import jax
+
+from lightgbm_tpu.analysis import load_allowlist, load_sequences
+from lightgbm_tpu.analysis import donation, jaxpr_lint, spmd
+
+pytestmark = pytest.mark.analysis
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+FIXTURES = os.path.join(_HERE, "analysis_fixtures")
+BAD_RANK = os.path.join(FIXTURES, "bad_rank.py")
+BAD_DONATE = os.path.join(FIXTURES, "bad_donate.py")
+BAD_LOOP = os.path.join(FIXTURES, "bad_loop.py")
+
+
+# -- LGB008: rank-divergent control flow --------------------------------------
+
+def test_lgb008_fixture_trips():
+    findings = spmd.rank_divergence([BAD_RANK])
+    rules = {f.rule for f in findings}
+    assert rules == {"LGB008-rank-divergence"}
+    # all three divergence shapes: rank attr, dead-rank verdict,
+    # process_index() — each anchored to its function
+    symbols = {f.symbol for f in findings}
+    assert {"BadNet.exchange", "BadNet.recover", "elect_root"} <= symbols
+    assert all(f.line > 0 for f in findings)
+
+
+def test_lgb008_repo_sites_are_exactly_the_vetted_ones():
+    """The tree's rank-gated collective paths are the three known star
+    protocol / root-GC sites — every one suppressed by an allowlist
+    entry that names the symbol and carries a reason."""
+    findings = spmd.rank_divergence()
+    assert {(f.file, f.symbol) for f in findings} == {
+        ("lightgbm_tpu/parallel/multihost.py", "DistributedNet.allgather"),
+        ("lightgbm_tpu/io/net.py", "SocketNet.__init__"),
+        ("lightgbm_tpu/io/net.py", "SocketNet.allgather"),
+    }
+    allow = load_allowlist()
+    kept, suppressed = spmd.run(traced=None)
+    assert kept == []
+    assert len(suppressed) >= 3
+    lgb008 = [e for e in allow if e["rule"] == "LGB008-rank-divergence"]
+    assert len(lgb008) == 3
+    assert all(e.get("reason") for e in lgb008)
+    assert all(e.get("symbol") for e in lgb008)
+
+
+# -- LGB010: event-loop blocking ----------------------------------------------
+
+def test_lgb010_fixture_trips():
+    findings = spmd.event_loop_blocking([BAD_LOOP])
+    assert {f.rule for f in findings} == {"LGB010-event-loop-blocking"}
+    msgs = "\n".join(f.message for f in findings)
+    assert "time.sleep" in msgs                 # hard blocker in _loop
+    assert "recv" in msgs                       # unguarded socket op
+    assert "block_until_ready" in msgs          # batcher callback sync
+    # the nested _done callback is in the checked closure
+    assert any(f.symbol and f.symbol.endswith("._done") for f in findings)
+
+
+def test_lgb010_gateway_loop_is_clean():
+    assert spmd.event_loop_blocking() == []
+
+
+# -- LGB009: use-after-donate -------------------------------------------------
+
+def test_lgb009_fixture_trips():
+    findings = donation.use_after_donate([BAD_DONATE])
+    assert {f.rule for f in findings} == {"LGB009-use-after-donate"}
+    msgs = {f.symbol: f.message for f in findings}
+    assert any("read again" in m for s, m in msgs.items()
+               if s == "BadTrainer.step")
+    assert any("donated position" in m for s, m in msgs.items()
+               if s == "BadTrainer.warm")
+
+
+def test_lgb009_repo_is_clean():
+    assert donation.use_after_donate() == []
+
+
+def test_lgb009_knows_the_repo_donation_sites():
+    """The donator map resolves every jit donation seam in the tree —
+    direct bindings, the partial-decorated score update, the fused-step
+    factory, and the train_async wrapper hop."""
+    donators = donation.collect_donators(donation._package_trees())
+    assert donators["_jit_tree_w"] == {1, 2}
+    assert donators["_score_add_leaf"] == {0}
+    assert donators["_jit_fused"] == {0}
+    assert donators["_fused_iter_fn"] == {0}    # factory returns _jit_fused
+    assert donators["train_async"] == {0, 1}    # wrapper forwards grad/hess
+
+
+# -- collective-order pinning -------------------------------------------------
+
+@pytest.fixture(scope="module")
+def traced_data():
+    """One traced data-parallel program, shared across the order tests."""
+    return jaxpr_lint.trace_programs(glob="wave_sharded_data")
+
+
+def test_sequences_json_matches_traced_program(traced_data):
+    assert spmd.check_sequences(traced_data) == []
+
+
+def test_sequence_mismatch_trips(traced_data):
+    pinned = load_sequences()
+    name = "wave_sharded_data"
+    got = spmd.extract_sequence(traced_data.closed[name])
+    assert len(got) >= 2
+
+    # a MOVED collective (same site count — invisible to budgets)
+    swapped = copy.deepcopy(pinned)
+    seq = swapped["programs"][name]
+    seq[0], seq[-1] = seq[-1], seq[0]
+    findings = spmd.check_sequences(traced_data, swapped)
+    assert [f.rule for f in findings] == ["collective-order"]
+    assert findings[0].symbol == name
+    assert "site 0" in findings[0].message
+
+    # a RESHAPED collective (same primitive and order, different wire)
+    reshaped = copy.deepcopy(pinned)
+    reshaped["programs"][name][0]["shape"] = [9999]
+    findings = spmd.check_sequences(traced_data, reshaped)
+    assert [f.rule for f in findings] == ["collective-order"]
+
+    # a program with no pin at all
+    unpinned = copy.deepcopy(pinned)
+    del unpinned["programs"][name]
+    findings = spmd.check_sequences(traced_data, unpinned)
+    assert [f.rule for f in findings] == ["collective-order"]
+    assert "no pinned sequence" in findings[0].message
+
+
+def test_dump_sequences_rederives_checked_in_file_bytes(tmp_path):
+    """--dump-sequences is byte-stable against the checked-in pin for
+    the programs traceable here (the full-set byte identity is asserted
+    end-to-end by the CLI dump in scripts/analysis_gate.sh workflow)."""
+    traced = jaxpr_lint.trace_programs()
+    if traced.skipped:
+        pytest.skip(f"untraceable programs on this platform: "
+                    f"{sorted(traced.skipped)}")
+    out = tmp_path / "sequences.json"
+    spmd.dump_sequences(traced, str(out))
+    from lightgbm_tpu.analysis.common import SEQUENCES_PATH
+    with open(SEQUENCES_PATH, "rb") as fh:
+        assert out.read_bytes() == fh.read()
+
+
+# -- cross-factorization order equality ---------------------------------------
+
+@pytest.mark.analysis(timeout=600)
+def test_collective_order_invariant_across_data_factorizations():
+    """tree_learner=data at 2 / 4 / 8 devices (incl. the emulated-pod
+    shape): shard widths differ, the (primitive, axes) order must not."""
+    sigs = {}
+    for ndev in (2, 4, 8):
+        if jax.device_count() < ndev:
+            pytest.skip(f"needs {ndev} devices")
+        closed = jaxpr_lint._trace_wave_sharded("data", ndev=ndev)
+        sigs[ndev] = spmd.order_signature(spmd.extract_sequence(closed))
+    assert sigs[2] == sigs[4] == sigs[8]
+    assert len(sigs[2]) > 0
+
+
+@pytest.mark.analysis(timeout=600)
+def test_collective_order_invariant_across_2d_factorizations():
+    """The 2-D hybrid at every (data, feature) factorization of 4
+    devices — 1x4 / 2x2 / 4x1 — plus the (4, 2) pod layout must issue
+    the identical collective order.  16 toy features (4 packed words)
+    make the feature-axis=4 shapes eligible."""
+    if jax.device_count() < 8:
+        pytest.skip("needs 8 devices")
+    sigs = {}
+    for shape in ((1, 4), (2, 2), (4, 1), (4, 2)):
+        closed = jaxpr_lint._trace_wave_sharded_2d(shape=shape, features=16)
+        sigs[shape] = spmd.order_signature(spmd.extract_sequence(closed))
+    ref = sigs[(2, 2)]
+    assert len(ref) > 0
+    assert all(sig == ref for sig in sigs.values()), {
+        k: len(v) for k, v in sigs.items()}
+
+
+def test_cross_factorization_findings_on_divergent_orders(traced_data):
+    """The gate-side check: same-mode programs with different orders are
+    flagged; identical orders are not."""
+    name = "wave_sharded_data"
+    tp = jaxpr_lint.TracedPrograms()
+    tp.closed["a"] = traced_data.closed[name]
+    tp.closed["b"] = traced_data.closed[name]
+    groups = {"data": ("a", "b")}
+    assert spmd.cross_factorization_findings(tp, groups) == []
+
+    # simulate a factorization whose trace lost its collectives
+    serial = jaxpr_lint._trace_wave_serial()
+    tp.closed["b"] = serial
+    findings = spmd.cross_factorization_findings(tp, groups)
+    assert [f.rule for f in findings] == ["collective-order-factorization"]
+
+
+# -- donation-liveness runtime assert -----------------------------------------
+
+@pytest.mark.analysis(timeout=600)
+def test_hlo_aliasing_present_for_every_donating_program():
+    if jax.device_count() < 2:
+        pytest.skip("needs 2 devices")
+    findings, status = donation.check_hlo_aliasing()
+    assert findings == []
+    assert status == {name: "aliased" for name in donation.DONATING_PROGRAMS}
