@@ -58,6 +58,8 @@ DEFAULT_FILES = (
     os.path.join("observability", "trace.py"),
     os.path.join("observability", "metrics_export.py"),
     os.path.join("observability", "drift.py"),
+    os.path.join("elastic", "controller.py"),
+    os.path.join("elastic", "epoch.py"),
 )
 
 _LOCK_FACTORIES = {"Lock", "RLock", "Condition", "Semaphore",

@@ -205,7 +205,7 @@ def cross_factorization_findings(traced, groups: Optional[Dict[str, Tuple[
 
 #: the default LGB008 analysis set (ISSUE: the layers elastic recovery
 #: will touch)
-RANK_DIRS = ("parallel", "io", "boosting")
+RANK_DIRS = ("parallel", "io", "boosting", "elastic")
 
 #: call names (attribute suffixes) that ARE collective / net ops: the
 #: host-side net seams (SocketNet / DistributedNet / LoopbackNet), the

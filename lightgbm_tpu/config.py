@@ -126,6 +126,10 @@ ALIAS_TABLE: Dict[str, str] = {
     # multi-host pod (parallel/multihost.py)
     "coordinator": "coordinator_address",
     "num_processes": "num_hosts", "num_process": "num_hosts",
+    # elastic pod training (lightgbm_tpu/elastic/)
+    "elastic_training": "elastic",
+    "max_recoveries": "elastic_max_recoveries",
+    "min_ranks": "elastic_min_ranks",
     # out-of-core streaming loader
     "chunk_rows": "stream_chunk_rows",
     "out_of_core": "two_round",
@@ -350,6 +354,24 @@ class Config:
     num_hosts: int = 1
     # this process's rank in [0, num_hosts); -1 = from LGBT_PROCESS_ID
     process_id: int = -1
+    # --- elastic pod training (lightgbm_tpu/elastic/) ---
+    # supervise the pod with the shrink-and-continue controller: a rank
+    # death mid-training re-forms membership over the survivors, re-deals
+    # the dead rank's rows via the from_stream loader, and resumes from
+    # the last snapshot — no operator action.  Only from_stream (two_round)
+    # data sources can re-deal; in-memory Datasets cannot
+    elastic: bool = False
+    # recovery budget: terminal failure after this many shrinks
+    elastic_max_recoveries: int = 3
+    # terminal structured failure when the survivor count drops below this
+    elastic_min_ranks: int = 1
+    # membership generation counter (INTERNAL — stamped by the controller
+    # into each epoch's worker config; 0 = the original membership)
+    elastic_epoch: int = 0
+    # per-epoch coordinator port = elastic_port_base + epoch (each epoch
+    # is a fresh jax.distributed cluster); 0 = derive from the port in
+    # coordinator_address
+    elastic_port_base: int = 0
     # --- reliability (lightgbm_tpu/reliability/) ---
     # hard cap on a single SocketNet/serving wire frame: a corrupt length
     # prefix fails with a ConnectionError instead of a multi-GB allocation

@@ -162,6 +162,21 @@ class Dataset:
     def construct(self) -> "Dataset":
         if self._constructed is None:
             cfg = Config.from_params(self.params)
+            if cfg.elastic and not (
+                    isinstance(self._raw_data, str) and cfg.two_round
+                    and self.reference is None
+                    and not _ConstructedDataset.is_binary_file(
+                        self._raw_data)):
+                import warnings
+                warnings.warn(
+                    "elastic=true but this Dataset is not a two_round "
+                    "file source: in-memory (and binary/reference) "
+                    "Datasets CANNOT re-deal rows after a membership "
+                    "shrink — whatever rows this process holds is all "
+                    "it will ever have. Only from_stream sources "
+                    "(two_round=true with a file path) survive elastic "
+                    "recovery; this run will NOT be elastic-safe.",
+                    RuntimeWarning, stacklevel=3)
             if isinstance(self._raw_data, str) and \
                     _ConstructedDataset.is_binary_file(self._raw_data):
                 self._constructed = _ConstructedDataset.load_binary(
@@ -187,11 +202,24 @@ class Dataset:
                 info = scan_data_file(self._raw_data, self.params)
                 shape_shim = type("_Shape", (), {
                     "shape": (info.num_rows, info.num_features)})
-                self._constructed = _ConstructedDataset.from_stream(
-                    self._raw_data, self.params, cfg,
-                    categorical=self._resolve_categorical(shape_shim),
-                    feature_names=self._resolve_feature_names(shape_shim),
-                    info=info)
+                from .parallel import multihost
+                if cfg.elastic and multihost.is_initialized():
+                    # elastic re-deal: rank / num_machines come from the
+                    # CURRENT membership epoch's live world, not config
+                    from .elastic.redeal import construct_elastic
+                    self._constructed = construct_elastic(
+                        self._raw_data, self.params, cfg,
+                        categorical=self._resolve_categorical(shape_shim),
+                        feature_names=self._resolve_feature_names(
+                            shape_shim),
+                        info=info)
+                else:
+                    self._constructed = _ConstructedDataset.from_stream(
+                        self._raw_data, self.params, cfg,
+                        categorical=self._resolve_categorical(shape_shim),
+                        feature_names=self._resolve_feature_names(
+                            shape_shim),
+                        info=info)
                 if self._label is not None:
                     self._constructed.metadata.set_label(self._label)
                 if self._weight is not None:

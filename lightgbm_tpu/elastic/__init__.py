@@ -1,0 +1,49 @@
+"""Elastic pod training — shrink-and-continue without operator action.
+
+Composes three pieces that already exist into a supervised recovery state
+machine (`ROADMAP.md` item 2c):
+
+  * the per-iteration heartbeat that NAMES a dead rank within the
+    collective deadline (`parallel/multihost.py`, PR 13) — now a typed
+    :class:`~lightgbm_tpu.parallel.multihost.RankDeathError`;
+  * crash-safe snapshots + bit-exact resume (`reliability/resume.py`,
+    PR 4) — now with the world-shape keys split out of the config
+    fingerprint so a post-shrink resume is accepted, not rejected;
+  * the PlacementRules mesh layer (`parallel/sharding.py`, PR 9), which
+    lays the SAME jitted programs over whatever device set the surviving
+    membership exposes.
+
+Architecture (the TorchElastic shape, forced by a measured constraint):
+jax.distributed cannot shrink in place — after a rank dies, the
+coordination service propagates fatal errors to the survivors and any
+``device_put`` against a multi-process sharding issues a gloo collective
+over the ORIGINAL world, which fails against the dead peer.  So each
+**membership epoch** is a fresh jax.distributed cluster in a fresh worker
+subprocess, supervised by a per-host **controller** (`controller.py`)
+that never touches devices:
+
+  1. epoch k's workers train; a death surfaces as ``RankDeathError``;
+  2. the survivors negotiate epoch k+1's membership over the STILL-LIVE
+     epoch-k KV store (`epoch.py` — the coordination service keeps
+     serving until its host process exits), write a verdict file and
+     exit with ``EXIT_RESHAPE``;
+  3. each controller reads its worker's verdict, enforces the
+     ``elastic_max_recoveries`` / ``elastic_min_ranks`` budget, and
+     relaunches a worker for epoch k+1: new coordinator (port =
+     base + epoch, hosted by the new rank 0), rows re-dealt over the
+     survivors via the ``from_stream`` loader (`redeal.py`), training
+     resumed from the last crash-safe snapshot to the ORIGINAL round
+     target.
+
+A zombie worker from epoch k cannot poison epoch k+1: the new epoch is a
+physically separate cluster (different coordinator port), and every
+verdict/KV key is generation-stamped.
+"""
+
+from .controller import (EXIT_RESHAPE, ElasticHostDead, ElasticResult,
+                         ElasticTerminalError, run_host)
+from .epoch import MembershipEpoch, negotiate_next_epoch
+
+__all__ = ["run_host", "ElasticResult", "ElasticTerminalError",
+           "ElasticHostDead", "EXIT_RESHAPE", "MembershipEpoch",
+           "negotiate_next_epoch"]

@@ -1,0 +1,171 @@
+"""Membership epochs: the agreed live-host set + generation counter.
+
+An epoch is the unit of cluster identity: ``(epoch, members)`` where
+``members`` is the ordered list of STABLE host ids still alive (host ids
+never renumber; a host's RANK within an epoch is its index in
+``members``).  Epoch k+1 is negotiated by epoch k's survivors over the
+epoch-k KV store immediately after a ``RankDeathError`` — the
+coordination service lives inside epoch k's process 0 and keeps serving
+until that process exits, which is exactly the window the negotiation
+uses (the same window `DistributedNet._missing_report` already relies on
+to name dead ranks).
+
+Protocol (all keys generation-stamped under ``elastic/e<k+1>/``):
+
+  1. every survivor posts ``ack/h<host>`` = its verdict (the dead-rank
+     set it observed, translated to host ids);
+  2. the ANCHOR — the lowest-host-id survivor — collects every proposed
+     member's ack with a deadline; a proposed member that never acks is
+     declared dead too (cascading failure during recovery), then the
+     anchor posts the canonical ``record``;
+  3. non-anchor survivors block on ``record``, make their verdict
+     DURABLE (the controller's verdict file), and only then post
+     ``got/h<host>`` via :func:`confirm_record`; the anchor waits for
+     every got-ack before returning, so it cannot exit (taking the KV
+     store — and, via the fatal-error poller, every still-running peer —
+     with it) while a peer's verdict is still in flight.
+
+If the anchor itself is among the dead — or the coordination service is
+already gone — the blocking reads time out and negotiation raises
+``ConnectionError``: control-plane loss is terminal by design (v1; a
+production deployment would re-anchor through an external store).
+
+The generation stamp is the zombie fence: a late-returning worker from
+epoch k that believes a DIFFERENT death happened writes only under its
+own proposed generation, and epoch k+1 runs on a physically separate
+coordinator anyway — its collectives can never interleave with the new
+epoch's.
+"""
+
+from __future__ import annotations
+
+import pickle
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence
+
+
+@dataclass
+class MembershipEpoch:
+    """One agreed generation of the pod."""
+
+    epoch: int
+    #: ordered STABLE host ids; a host's rank is its index here
+    members: List[int]
+    #: host ids declared dead in the transition INTO this epoch
+    dead_hosts: List[int] = field(default_factory=list)
+    coordinator: str = ""
+
+    def rank_of(self, host_id: int) -> int:
+        return self.members.index(int(host_id))
+
+    def to_dict(self) -> dict:
+        return {"epoch": int(self.epoch),
+                "members": [int(m) for m in self.members],
+                "dead_hosts": [int(d) for d in self.dead_hosts],
+                "coordinator": self.coordinator}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "MembershipEpoch":
+        return cls(epoch=int(d["epoch"]),
+                   members=[int(m) for m in d["members"]],
+                   dead_hosts=[int(x) for x in d.get("dead_hosts", [])],
+                   coordinator=str(d.get("coordinator", "")))
+
+
+def coordinator_for_epoch(host: str, port_base: int, epoch: int) -> str:
+    """Epoch k's fresh jax.distributed cluster address: ``port_base + k``
+    on the coordinator host.  A new port per generation is what isolates
+    epoch k+1 from epoch k's dying coordination service (and its
+    zombies)."""
+    return f"{host}:{int(port_base) + int(epoch)}"
+
+
+def _kv():
+    from ..parallel.multihost import _kv_client
+    return _kv_client()
+
+
+def negotiate_next_epoch(current: MembershipEpoch, my_host: int,
+                         dead_ranks: Sequence[int],
+                         deadline_s: float = 20.0,
+                         client=None) -> MembershipEpoch:
+    """Agree epoch k+1's membership among epoch k's survivors (see module
+    docstring for the protocol).  ``dead_ranks`` are epoch-k RANKS from
+    the ``RankDeathError`` verdict; returns the canonical next epoch.
+    Raises ``ConnectionError`` on control-plane loss (anchor dead or
+    coordination service gone)."""
+    if client is None:
+        client = _kv()
+    nxt = int(current.epoch) + 1
+    prefix = f"elastic/e{nxt}"
+    dead_hosts = sorted({int(current.members[r]) for r in dead_ranks
+                         if 0 <= int(r) < len(current.members)})
+    proposed = [h for h in current.members if h not in dead_hosts]
+    deadline_ms = max(int(deadline_s * 1000), 1)
+
+    client.key_value_set_bytes(
+        f"{prefix}/ack/h{int(my_host)}",
+        pickle.dumps({"host": int(my_host), "dead_hosts": dead_hosts}))
+
+    anchor = min(proposed)
+    # the anchor is rank 0 of the proposed membership — the one
+    # deliberately rank-asymmetric schedule in this module (vetted via
+    # the LGB008 allowlist): exactly one process may write the canonical
+    # record, and survivors cannot elect one without a store round-trip
+    rank = proposed.index(int(my_host)) if int(my_host) in proposed else -1
+    if rank == 0:
+        # anchor: collect every proposed member's ack; a survivor that
+        # cannot reach the KV store in time is dead for epoch k+1 too
+        confirmed: List[int] = []
+        union_dead = set(dead_hosts)
+        for h in proposed:
+            try:
+                ack = pickle.loads(client.blocking_key_value_get_bytes(
+                    f"{prefix}/ack/h{h}", deadline_ms))
+                confirmed.append(h)
+                union_dead.update(int(x) for x in ack.get("dead_hosts", ()))
+            except Exception:
+                union_dead.add(int(h))
+        members = [h for h in confirmed if h not in union_dead]
+        record = MembershipEpoch(
+            epoch=nxt, members=members,
+            dead_hosts=sorted(union_dead),
+            coordinator=current.coordinator)
+        client.key_value_set_bytes(f"{prefix}/record",
+                                   pickle.dumps(record.to_dict()))
+        # hold the KV store open until every surviving peer has read the
+        # record — the anchor process exiting kills the coordination
+        # service, and a peer mid-read would see control-plane loss
+        for h in members:
+            if h == int(my_host):
+                continue
+            try:
+                client.blocking_key_value_get_bytes(
+                    f"{prefix}/got/h{h}", deadline_ms)
+            except Exception:
+                pass  # peer died after acking; epoch k+1's own
+                # heartbeat will name it within one iteration
+        return record
+    try:
+        raw = client.blocking_key_value_get_bytes(f"{prefix}/record",
+                                                  deadline_ms)
+    except Exception as e:
+        raise ConnectionError(
+            f"membership negotiation for epoch {nxt} lost the control "
+            f"plane (anchor host {anchor} dead or coordination service "
+            f"gone): {e}") from None
+    return MembershipEpoch.from_dict(pickle.loads(raw))
+
+
+def confirm_record(record: MembershipEpoch, my_host: int,
+                   client=None) -> None:
+    """Post this host's ``got`` ack for the canonical record — called by
+    the worker AFTER its verdict file is durably on disk.  The ack
+    releases the anchor, whose exit aborts every peer still running
+    (the coordination service dies with it), so anything that must
+    survive the transition has to be written before this call."""
+    if client is None:
+        client = _kv()
+    client.key_value_set_bytes(
+        f"elastic/e{int(record.epoch)}/got/h{int(my_host)}",
+        pickle.dumps(True))

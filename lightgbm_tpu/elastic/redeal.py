@@ -1,0 +1,139 @@
+"""Shard re-deal for elastic membership changes.
+
+When a membership epoch shrinks, the dead host's rows must land on the
+survivors.  In-memory Datasets CANNOT do this — whatever rows a host
+uploaded at construct time is all it will ever have.  A ``from_stream``
+(``two_round=true``) source can: the file outlives every host, so each
+epoch simply re-runs the two-pass loader with ``rank/num_machines``
+re-derived from the CURRENT membership — mod-dealing
+(``global_row % num_machines == rank``) re-deals every row, including the
+dead rank's, with no per-row bookkeeping.
+
+The pass-1 bin sample is drawn from the FULL file with the config seed,
+so every rank of every epoch derives the IDENTICAL mapper table without a
+single collective; the binned shards are then exchanged over the
+DistributedNet KV seam and reassembled in global row order on every host
+(:func:`assemble_global`).  The assembled dataset is bit-identical to a
+single-host ``from_matrix``/``from_stream`` construction of the same file
+— so the placed global mesh arrays, and therefore the trained trees, do
+not depend on the epoch's shard layout at all.  That is what makes
+"resume from epoch k's snapshot under epoch k+1's membership" exact:
+only the mesh over which rows are laid changes, never the rows.
+
+Cost model: each host streams the whole file but BINS only its 1/M of
+the rows (the expensive part of pass 2), then holds the full binned
+matrix (uint8 — 1/8th of the float64 matrix the in-memory path
+materializes) after the exchange.  The exchange itself moves O(n·f)
+bytes through the coordinator KV store — fine at emulation scale and a
+documented v1 limit for real pods (a production pod would exchange over
+the mesh interconnect instead).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..config import Config
+from ..dataset import Metadata, _ConstructedDataset
+
+
+def _round_up(v: int, m: int) -> int:
+    return ((int(v) + m - 1) // m) * m
+
+
+def construct_elastic(path: str, params: Optional[dict], cfg: Config,
+                      categorical: Sequence[int] = (),
+                      feature_names: Optional[List[str]] = None,
+                      info=None, net=None) -> _ConstructedDataset:
+    """Elastic construction of ``path``: two-pass stream of THIS rank's
+    mod-dealt shard, then global reassembly over the pod net.  rank /
+    num_machines come from the live jax.distributed world (the current
+    membership), never from config — config still describes the ORIGINAL
+    launch."""
+    from ..parallel.multihost import DistributedNet
+    from ..reliability.metrics import rel_inc
+
+    if net is None:
+        net = DistributedNet(cfg, namespace="redeal")
+    if cfg.pre_partition:
+        raise ValueError(
+            "elastic=true cannot re-deal pre_partition=true shards: each "
+            "host's file holds ONLY its rows, so a dead host's rows are "
+            "unreachable — use a shared data file (pre_partition=false)")
+    shard = _ConstructedDataset.from_stream(
+        path, params, cfg, categorical=categorical,
+        feature_names=feature_names, rank=net.rank,
+        num_machines=net.num_machines, info=info)
+    if net.num_machines <= 1:
+        return shard
+    rel_inc("elastic.redeal_rows", int(shard.num_data))
+    return assemble_global(shard, net)
+
+
+def assemble_global(shard: _ConstructedDataset,
+                    net) -> _ConstructedDataset:
+    """Exchange the mod-dealt binned shards and reassemble the FULL
+    dataset in global row order on every rank (mutates ``shard`` in place
+    and returns it).  Row padding is sized to ``lcm(tpu_row_block,
+    device_count)`` so the row axis of every placed array divides evenly
+    across the global mesh whatever the survivor count is."""
+    import jax
+
+    if getattr(shard.metadata, "query_boundaries", None) is not None:
+        raise ValueError(
+            "elastic re-deal does not support ranking query groups yet — "
+            "whole-query dealing changes per-rank row counts across "
+            "epochs; train lambdarank non-elastically")
+    n = int(shard.num_data_global)
+    n_local = int(shard.num_data)
+    weights = getattr(shard.metadata, "weights", None)
+    payload = (np.asarray(shard.global_rows, dtype=np.int64),
+               np.ascontiguousarray(shard.bins[:, :n_local]),
+               np.asarray(shard.metadata.label, dtype=np.float64),
+               None if weights is None else np.asarray(weights))
+    parts = net.allgather(payload)
+
+    cfg = shard.config
+    ndev = max(jax.device_count(), 1)
+    block = max(int(cfg.tpu_row_block), 128)
+    # BOTH padded axes must divide by the CURRENT epoch's device count or
+    # the parallel router falls back to the masked GSPMD learner — whose
+    # closed-over bins cannot span a multi-process mesh.  The row block
+    # keeps the wave layout; the feature tile keeps the Pallas layout.
+    n_pad = _round_up(max(n, 1), math.lcm(block, ndev))
+    f_pad = _round_up(int(shard.bins.shape[0]),
+                      math.lcm(_ConstructedDataset.FEATURE_TILE, ndev))
+    bins = np.zeros((f_pad, n_pad), dtype=shard.bins.dtype)
+    labels = np.zeros(n, dtype=np.float64)
+    wout = None
+    covered = 0
+    for rows, b, lab, w in parts:
+        bins[:b.shape[0], rows] = b
+        labels[rows] = lab
+        if w is not None:
+            if wout is None:
+                wout = np.zeros(n, dtype=np.float64)
+            wout[rows] = w
+        covered += len(rows)
+    if covered != n:
+        raise ValueError(f"re-deal reassembly covered {covered} rows, "
+                         f"expected {n} — shards overlap or are missing")
+    shard.bins = bins
+    shard.num_data = n
+    shard.num_data_padded = n_pad
+    shard.metadata = Metadata(n)
+    shard.metadata.set_label(labels)
+    if wout is not None:
+        shard.metadata.set_weights(wout)
+    # after reassembly this is a full-coverage dataset, not a shard
+    shard.global_rows = np.arange(n, dtype=np.int64)
+    shard.row_offset = 0
+    shard.num_data_global = n
+    # drop caches derived from the pre-exchange shard layout
+    shard._device_bins = None
+    shard._feature_meta = None
+    shard._binner_arrays = None
+    return shard

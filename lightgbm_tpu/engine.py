@@ -64,6 +64,10 @@ class Booster:
                 self.gbdt.telemetry.set_distributed(
                     process_count=int(self._mh_net.num_machines),
                     process_index=int(self._mh_net.rank))
+                if self.cfg.elastic:
+                    self.gbdt.telemetry.set_elastic(
+                        epoch=int(self.cfg.elastic_epoch),
+                        members=int(self._mh_net.num_machines))
         elif model_file is not None:
             with open(model_file) as fh:
                 self._load_from_string(fh.read())
@@ -107,8 +111,19 @@ class Booster:
             # detection without an extra collective
             payload = self._last_step_s if tel.enabled else None
             with tel.phase("heartbeat"):
-                peers = self._mh_net.heartbeat(self.gbdt.iter_,
-                                               payload=payload)
+                from .parallel.multihost import RankDeathError
+                try:
+                    peers = self._mh_net.heartbeat(self.gbdt.iter_,
+                                                   payload=payload)
+                except RankDeathError as e:
+                    # the engine's abort verdict: which iteration of which
+                    # membership epoch died — the elastic controller keys
+                    # its recovery on exactly this (epoch, dead_ranks) pair
+                    raise RankDeathError(
+                        f"training aborted before iteration "
+                        f"{self.gbdt.iter_ + 1} (membership epoch "
+                        f"{e.epoch}): {e}", dead_ranks=e.dead_ranks,
+                        epoch=e.epoch) from None
             if tel.enabled:
                 self._note_rank_skew(peers)
         if not tel.enabled:

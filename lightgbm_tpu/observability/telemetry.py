@@ -67,7 +67,11 @@ TEL_NAMES = {
 # `serving/batcher.py` TenantStats) and reports gain an optional "drift"
 # section (PSI/KS baseline-vs-window verdict over the traffic recorder —
 # `observability/drift.py`)
-SCHEMA_VERSION = 8
+# v9: optional "elastic" section (membership epoch / survivor count set by
+# the engine on elastic pods; the per-host controller merges the recovery
+# totals — epochs, recoveries, ranks_lost, re-dealt row count, recovery
+# wall-time — into the final report, `lightgbm_tpu/elastic/controller.py`)
+SCHEMA_VERSION = 9
 
 
 def provenance_section(extra: Optional[Dict[str, Any]] = None
@@ -154,6 +158,7 @@ class Telemetry:
         # (rank skew, clock handshake) and per-phase tracemalloc peaks
         self._provenance_extra: Dict[str, Any] = {}
         self._distributed: Dict[str, Any] = {}
+        self._elastic: Dict[str, Any] = {}
         self._phase_heap: Dict[str, int] = {}      # name -> peak bytes
         self._heap_stack: List[int] = []
 
@@ -243,6 +248,12 @@ class Telemetry:
         if self.enabled:
             self._distributed.update(kw)
 
+    def set_elastic(self, **kw: Any) -> None:
+        """Merge elastic-pod facts (membership epoch, survivor count,
+        recovery totals) into the report's optional ``elastic`` section."""
+        if self.enabled:
+            self._elastic.update(kw)
+
     def last_iteration_s(self) -> Optional[float]:
         """Duration of the most recent "iteration" phase occurrence — the
         per-rank step timing that rides the liveness heartbeat."""
@@ -308,12 +319,15 @@ class Telemetry:
         # failure accounting travels with every report (training AND
         # serving) — the section is process-wide by design
         from ..reliability.metrics import reliability_section
-        return {"schema_version": SCHEMA_VERSION, "enabled": self.enabled,
-                "phases": phases, "iterations": it, "counters": counters,
-                "gauges": gauges, "collectives": coll,
-                "provenance": provenance_section(self._provenance_extra),
-                "distributed": self._distributed_section(phases),
-                "reliability": reliability_section()}
+        rep = {"schema_version": SCHEMA_VERSION, "enabled": self.enabled,
+               "phases": phases, "iterations": it, "counters": counters,
+               "gauges": gauges, "collectives": coll,
+               "provenance": provenance_section(self._provenance_extra),
+               "distributed": self._distributed_section(phases),
+               "reliability": reliability_section()}
+        if self._elastic:
+            rep["elastic"] = dict(self._elastic)
+        return rep
 
     def _distributed_section(self, phases_ms: Dict[str, Any]
                              ) -> Dict[str, Any]:

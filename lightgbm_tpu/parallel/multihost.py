@@ -48,6 +48,27 @@ _initialized = False
 _ns_counts: dict = {}
 
 
+class RankDeathError(ConnectionError):
+    """A collective's deadline scan NAMED dead rank(s).
+
+    Subclasses ``ConnectionError`` so every existing caller (and the PR 4
+    rank-crash drills asserting on ConnectionError text) keeps working;
+    the elastic controller (`lightgbm_tpu/elastic/`) catches THIS type to
+    distinguish "a peer died, shrink and continue" from "the coordinator
+    itself is unreachable" (plain ConnectionError — not recoverable by
+    re-forming membership, the control plane is gone).
+
+    ``dead_ranks`` are rank ids within the CURRENT membership epoch;
+    ``epoch`` is that membership epoch's generation counter (0 for
+    non-elastic pods), so a verdict from epoch k can never be misread as
+    naming ranks of epoch k+1's (re-numbered) membership."""
+
+    def __init__(self, message: str, dead_ranks=(), epoch: int = 0):
+        super().__init__(message)
+        self.dead_ranks = list(dead_ranks)
+        self.epoch = int(epoch)
+
+
 def resolve_multihost(cfg=None) -> Optional[Tuple[str, int, int]]:
     """(coordinator_address, num_processes, process_id) this run asks for,
     or None for a single-host run.  Config keys win over the LGBT_*
@@ -188,6 +209,10 @@ class DistributedNet:
             if deadline_s <= 0.0:
                 deadline_s = float(getattr(cfg, "time_out", 120) or 120)
         self.deadline_s = float(deadline_s)
+        # membership generation this net belongs to (elastic runs bump it
+        # per shrink; 0 = the original membership).  Stamped into every
+        # dead-rank verdict so recovery code can reject stale verdicts.
+        self.epoch = int(getattr(cfg, "elastic_epoch", 0) or 0)
         # distinct key prefix per net instance: the lagged GC leaves each
         # net's FINAL round keys behind, and a later net restarting _seq at
         # 1 would collide with them (ALREADY_EXISTS from the coordinator).
@@ -226,10 +251,19 @@ class DistributedNet:
                 missing, report = self._missing_report(prefix)
                 rel_inc("net.multihost_collective_timeouts")
                 rel_inc("net.multihost_peers_dead", max(len(missing), 1))
-                raise ConnectionError(
-                    f"multihost collective #{seq} timed out after "
-                    f"{self.deadline_s:.1f}s on rank {self.rank}: "
-                    f"{report} (coordinator error: {e})") from None
+                msg = (f"multihost collective #{seq} timed out after "
+                       f"{self.deadline_s:.1f}s on rank {self.rank} "
+                       f"(membership epoch {self.epoch}): {report} "
+                       f"(coordinator error: {e})")
+                if missing:
+                    # a NAMED dead peer is the recoverable verdict: the
+                    # elastic controller re-forms membership over the
+                    # survivors.  No named rank (all posted late / scan
+                    # failed) means the coordinator itself is suspect —
+                    # stay a plain ConnectionError.
+                    raise RankDeathError(msg, dead_ranks=missing,
+                                         epoch=self.epoch) from None
+                raise ConnectionError(msg) from None
         # best-effort GC, lagged ONE round: rank r posting for round N proves
         # its round N-1 allgather returned, i.e. it read every N-1 key — so
         # only once ALL ranks posted round N are round N-1's keys dead.

@@ -35,6 +35,18 @@ _SNAP_RE = re.compile(r"\.snapshot_iter_(\d+)$")
 META_SUFFIX = ".meta.json"
 STATE_SUFFIX = ".state.pkl"
 
+# config fields that describe the WORLD SHAPE, not the training semantics:
+# an elastic shrink changes every one of these (fewer hosts, re-dealt
+# shards, a smaller mesh) while the model being trained is the same model.
+# They are hashed separately (``topology_fingerprint``) so a non-elastic
+# resume can stay strict while an elastic resume accepts a topology change
+# with a warning instead of rejecting its own snapshots as
+# ``fingerprint_mismatch``.
+_TOPOLOGY_KEYS = frozenset({
+    "coordinator_address", "num_hosts", "process_id", "num_machines",
+    "parallel_mesh", "tree_learner",
+})
+
 # config fields with no bearing on what the trained trees look like —
 # everything else (objective, learning rates, bin config, learner knobs,
 # seeds, ...) participates in the fingerprint
@@ -56,16 +68,34 @@ _VOLATILE_KEYS = frozenset({
     "lifecycle_watch_interval_s", "lifecycle_error_rate_max",
     "lifecycle_shed_rate_max",
     "is_parallel", "is_parallel_find_bin", "_FIELD_TYPES",
+    "elastic", "elastic_max_recoveries", "elastic_min_ranks",
+    "elastic_epoch", "elastic_port_base",
 })
+
+
+def _hash(d: Dict[str, Any]) -> str:
+    blob = json.dumps(d, sort_keys=True, default=str).encode()
+    return hashlib.sha256(blob).hexdigest()[:16]
 
 
 def config_fingerprint(cfg) -> str:
     """Stable hash of the training-semantics subset of a ``Config`` —
     ``num_iterations`` is excluded on purpose so a resumed run may extend
-    the round count."""
-    d = {k: v for k, v in cfg.to_dict().items() if k not in _VOLATILE_KEYS}
-    blob = json.dumps(d, sort_keys=True, default=str).encode()
-    return hashlib.sha256(blob).hexdigest()[:16]
+    the round count, and the world-shape keys (``_TOPOLOGY_KEYS``) are
+    hashed separately by :func:`topology_fingerprint` so an elastic
+    shrink does not invalidate its own snapshots."""
+    d = {k: v for k, v in cfg.to_dict().items()
+         if k not in _VOLATILE_KEYS and k not in _TOPOLOGY_KEYS}
+    return _hash(d)
+
+
+def topology_fingerprint(cfg) -> str:
+    """Stable hash of the world-shape subset of a ``Config`` (hosts, rank,
+    mesh, shard count).  Recorded alongside ``config_fingerprint`` in the
+    snapshot sidecar; a mismatch is fatal for a plain resume and a
+    warning + ``snapshots_resumed_after_shrink`` tick for an elastic one."""
+    d = {k: getattr(cfg, k, None) for k in sorted(_TOPOLOGY_KEYS)}
+    return _hash(d)
 
 
 def snapshot_path(output_model: str, iteration: int) -> str:
@@ -86,7 +116,8 @@ def list_snapshots(output_model: str) -> List[Tuple[int, str]]:
 
 def write_snapshot_meta(path: str, iteration: int, cfg) -> None:
     meta = {"iteration": int(iteration),
-            "config_fingerprint": config_fingerprint(cfg)}
+            "config_fingerprint": config_fingerprint(cfg),
+            "topology_fingerprint": topology_fingerprint(cfg)}
     tmp = path + META_SUFFIX + ".tmp"
     with open(tmp, "w") as fh:
         json.dump(meta, fh)
@@ -100,9 +131,14 @@ def write_snapshot_state(path: str, gbdt) -> None:
     adds by a ulp — restoring the LIVE score array is what makes a
     resumed run's model text bit-identical to an uninterrupted one."""
     state: Dict[str, Any] = {
-        "score": np.asarray(gbdt.train_score.score),
         "iter": int(gbdt.iter_),
     }
+    score = gbdt.train_score.score
+    if bool(getattr(score, "is_fully_addressable", True)):
+        state["score"] = np.asarray(score)
+    # else: a pod-sharded global array — this host cannot materialize the
+    # full score, and a shrink re-deals rows anyway, so the resumed run
+    # replays scores from tree traversal (the always-correct path).
     for attr in ("_bag_rng", "_feat_rng", "_drop_rng"):
         rng = getattr(gbdt, attr, None)
         if rng is not None:
@@ -129,13 +165,19 @@ def restore_training_state(gbdt, state: Dict[str, Any]) -> bool:
     score = state.get("score")
     if score is not None:
         cur = gbdt.train_score.score
-        if tuple(np.shape(score)) != tuple(cur.shape):
+        if not bool(getattr(cur, "is_fully_addressable", True)):
+            warnings.warn("live score is pod-sharded (not fully "
+                          "addressable on this host); resuming from the "
+                          "replayed score instead of the snapshot state")
+            score = None
+        elif tuple(np.shape(score)) != tuple(cur.shape):
             warnings.warn("snapshot score state shape "
                           f"{np.shape(score)} != {tuple(cur.shape)}; "
                           "resuming from the replayed score instead")
             return False
-        import jax.numpy as jnp
-        gbdt.train_score.score = jnp.asarray(score)
+        if score is not None:
+            import jax.numpy as jnp
+            gbdt.train_score.score = jnp.asarray(score)
     for attr in ("_bag_rng", "_feat_rng", "_drop_rng"):
         rng = getattr(gbdt, attr, None)
         if rng is not None and attr in state:
@@ -143,11 +185,16 @@ def restore_training_state(gbdt, state: Dict[str, Any]) -> bool:
     return True
 
 
-def _validate(path: str,
-              fingerprint: Optional[str] = None) -> Tuple[bool, str, str]:
+def _validate(path: str, fingerprint: Optional[str] = None,
+              topology: Optional[str] = None,
+              allow_topology_change: bool = False
+              ) -> Tuple[bool, str, str]:
     """(ok, kind, reason) — ``kind`` is the machine-readable rejection
     class (``unreadable`` / ``truncated`` / ``sidecar_unreadable`` /
-    ``fingerprint_mismatch``) the reliability counters key on."""
+    ``fingerprint_mismatch`` / ``topology_mismatch``) the reliability
+    counters key on; an ACCEPTED topology change (elastic resume) comes
+    back as ``kind == "topology_changed"`` so the caller can warn and
+    count it."""
     try:
         with open(path) as fh:
             text = fh.read()
@@ -169,6 +216,20 @@ def _validate(path: str,
                 return False, "fingerprint_mismatch", \
                     (f"config fingerprint mismatch (snapshot "
                      f"{got}, current {fingerprint})")
+            got_topo = meta.get("topology_fingerprint")
+            # pre-split sidecars carry no topology fingerprint: nothing
+            # to compare, same acceptance as the pre-sidecar case
+            if topology is not None and got_topo is not None \
+                    and got_topo != topology:
+                if not allow_topology_change:
+                    return False, "topology_mismatch", \
+                        (f"world-shape fingerprint mismatch (snapshot "
+                         f"{got_topo}, current {topology}) — this is not "
+                         f"an elastic run; refusing to resume a model "
+                         f"trained under a different topology")
+                return True, "topology_changed", \
+                    (f"topology changed (snapshot {got_topo}, current "
+                     f"{topology}) — accepted for elastic resume")
         else:
             warnings.warn(f"snapshot {path} has no metadata sidecar; "
                           f"resuming without a config-fingerprint check")
@@ -198,9 +259,16 @@ def find_resume_snapshot(output_model: str,
     if not output_model:
         return None
     fp = config_fingerprint(cfg) if cfg is not None else None
+    topo = topology_fingerprint(cfg) if cfg is not None else None
+    elastic = bool(getattr(cfg, "elastic", False)) if cfg is not None \
+        else False
     for iteration, path in reversed(list_snapshots(output_model)):
-        ok, kind, reason = _validate(path, fp)
+        ok, kind, reason = _validate(path, fp, topology=topo,
+                                     allow_topology_change=elastic)
         if ok:
+            if kind == "topology_changed":
+                warnings.warn(f"elastic resume from {path}: {reason}")
+                rel_inc("snapshots_resumed_after_shrink")
             return iteration, path
         warnings.warn(f"skipping snapshot {path}: {reason}")
         rel_inc("snapshots_rejected")

@@ -163,6 +163,66 @@ def _job_observe(spec):
             "counters": rep.get("counters")}
 
 
+def _job_elastic(spec):
+    """One elastic AGENT (per-host controller): supervise this host's
+    worker subprocess through every membership epoch via
+    ``lightgbm_tpu.elastic.run_host``.  The agent process itself never
+    initializes jax.distributed — each epoch's worker subprocess joins its
+    own fresh cluster.  Reports the final model text, the epoch history,
+    the controller-side reliability counters and the worker's merged
+    telemetry ``elastic`` section (or the structured failure)."""
+    from lightgbm_tpu.elastic import (ElasticHostDead, ElasticTerminalError,
+                                      run_host)
+    from lightgbm_tpu.reliability.metrics import rel_counters
+
+    params = {"objective": "binary", "num_leaves": 7, "max_bin": 31,
+              "min_data_in_leaf": 5, "verbosity": -1, "metric": "none",
+              "tree_learner": spec.get("mode", "data"),
+              "tpu_hist_dtype": "float64", "tpu_double_precision": True,
+              "elastic": True,
+              "elastic_min_ranks": int(spec.get("min_ranks", 1)),
+              "elastic_max_recoveries": int(spec.get("max_recoveries", 3)),
+              "coordinator_address": f"127.0.0.1:{spec['port']}",
+              "net_collective_deadline_s": spec.get("deadline_s", 6),
+              "telemetry": True}
+    if spec.get("telemetry_out"):
+        params["telemetry_out"] = spec["telemetry_out"]
+    if spec.get("trace_out"):
+        params["trace_out"] = spec["trace_out"]
+    cache = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         ".jax_cache")
+    out = {"rank": spec["rank"], "ok": False}
+    try:
+        res = run_host(
+            params, spec["data"], int(spec.get("iters", 6)),
+            host_id=spec["rank"], num_hosts=spec["num_hosts"],
+            workdir=spec["workdir"], enable_x64=True, cache_dir=cache,
+            negotiate_deadline_s=float(spec.get("negotiate_deadline_s", 20)),
+            worker_timeout_s=float(spec.get("worker_timeout_s", 420)))
+        with open(res.model_path) as fh:
+            model = fh.read()
+        out.update({
+            "ok": True, "model": model, "history": res.history,
+            "recoveries": res.recoveries, "ranks_lost": res.ranks_lost,
+            "recovery_wall_s": res.recovery_wall_s,
+            "iterations": res.result.get("iterations"),
+            "elastic": res.result.get("elastic"),
+            "report_elastic": (res.report or {}).get("elastic"),
+            "report_schema_version": (res.report or {}).get(
+                "schema_version"),
+            "worker_counters": (res.report or {}).get(
+                "reliability", {}).get("counters", {}),
+        })
+    except ElasticTerminalError as e:
+        out.update({"error_kind": "terminal", "error": str(e),
+                    "history": e.history})
+    except ElasticHostDead as e:
+        out.update({"error_kind": "host_dead", "error": str(e),
+                    "rc": e.rc})
+    out["rel_counters"] = rel_counters()
+    return out
+
+
 def _job_chaos(spec):
     from lightgbm_tpu.config import Config
     from lightgbm_tpu.parallel import multihost
@@ -183,22 +243,53 @@ def _job_chaos(spec):
     except ConnectionError as e:
         out["survived_error"] = str(e)
         out["elapsed_s"] = round(time.time() - t0, 3)
+        out["dead_ranks"] = list(getattr(e, "dead_ranks", ()))
     out["rel_counters"] = rel_counters()
     return out
+
+
+def _chaos_quiesce(spec, dead_ranks):
+    """Leader-LAST exit ordering for the chaos drill: the coordination
+    service lives in rank 0's process and its exit SIGABRTs (via the
+    fatal-error poller) any survivor still writing its report — the same
+    invariant `lightgbm_tpu/elastic` honors.  Rank 0 waits for the OTHER
+    survivors' report files (the typed RankDeathError names who will
+    never write one) before exiting.  Filesystem, not KV: reads against
+    the in-process coordination service can crash it natively, and the
+    wait must stay SHORT — the service's own missed-heartbeat fuse for
+    the deliberately-killed rank aborts rank 0 a few seconds after the
+    survivors' deadline scan fires."""
+    try:
+        if int(spec["rank"]) != 0:
+            return
+        outdir = os.path.dirname(os.path.abspath(spec["out"]))
+        peers = [os.path.join(outdir, f"r{r}.json")
+                 for r in range(int(spec["num_hosts"]))
+                 if r != 0 and r not in set(dead_ranks)]
+        deadline = time.time() + 3.0
+        while time.time() < deadline:
+            if all(os.path.exists(p) for p in peers):
+                break
+            time.sleep(0.05)
+    except Exception:
+        pass
 
 
 def main():
     spec = json.loads(sys.argv[1])
     _setup(spec)
     job = {"train": _job_train, "chaos": _job_chaos,
-           "observe": _job_observe}[spec.get("job", "train")]
+           "observe": _job_observe,
+           "elastic": _job_elastic}[spec.get("job", "train")]
     out = job(spec)
     with open(spec["out"], "w") as fh:
         json.dump(out, fh)
     print(f"rank {spec['rank']} ok", flush=True)
     if spec.get("job") == "chaos":
-        # skip jax.distributed's atexit shutdown barrier: with a peer
+        # report is durable — quiesce leader-last, then skip
+        # jax.distributed's atexit shutdown barrier: with a peer
         # deliberately dead it SIGABRTs the survivors after their report
+        _chaos_quiesce(spec, out.get("dead_ranks") or [])
         os._exit(0)
 
 

@@ -58,7 +58,8 @@ def pytest_runtest_call(item):
     marker = item.get_closest_marker("serving") \
         or item.get_closest_marker("chaos") \
         or item.get_closest_marker("analysis") \
-        or item.get_closest_marker("lifecycle")
+        or item.get_closest_marker("lifecycle") \
+        or item.get_closest_marker("elastic")
     if marker is None or not hasattr(signal, "SIGALRM"):
         yield
         return

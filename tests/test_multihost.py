@@ -237,6 +237,293 @@ def test_host_crash_names_dead_rank(tmp_path):
         assert ctr.get("net.multihost_peers_dead", 0) >= 1
 
 
+# -- elastic training: shrink-and-continue chaos drills ----------------------
+
+ELASTIC_ITERS = 6
+
+
+def _write_train_csv(path, seed=0, n=600, f=30):
+    """The deterministic gate problem as a CSV file (label first column) —
+    elastic training NEEDS a file source: only ``from_stream`` can re-deal
+    a dead host's rows."""
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    y = (X[:, 0] + np.sin(X[:, 1]) + 0.3 * rng.randn(n) > 0).astype(float)
+    with open(path, "w") as fh:
+        for i in range(n):
+            fh.write(",".join([repr(float(y[i]))] +
+                              [repr(float(v)) for v in X[i]]) + "\n")
+    return X, y
+
+
+def _auc(y, score):
+    """Tie-averaged rank AUC (no sklearn dependency in the assert path)."""
+    y = np.asarray(y) > 0
+    s = np.asarray(score, dtype=np.float64)
+    order = np.argsort(s, kind="mergesort")
+    ranks = np.empty(len(s), dtype=np.float64)
+    i = 0
+    while i < len(s):
+        j = i
+        while j + 1 < len(s) and s[order[j + 1]] == s[order[i]]:
+            j += 1
+        ranks[order[i:j + 1]] = (i + j) / 2.0 + 1.0
+        i = j + 1
+    n1 = int(y.sum())
+    n0 = len(y) - n1
+    return (ranks[y].sum() - n1 * (n1 + 1) / 2.0) / (n1 * n0)
+
+
+def _elastic_specs(tmp_path, nproc, data, name, **extra):
+    port = _free_port()
+    specs = []
+    for r in range(nproc):
+        specs.append(dict(
+            rank=r, num_hosts=nproc, port=port, local_devices=1,
+            job="elastic", data=data, iters=ELASTIC_ITERS,
+            workdir=str(tmp_path / name),
+            telemetry_out=str(tmp_path / f"{name}_telem_h{r}.json"),
+            out=str(tmp_path / f"{name}_r{r}.json"), **extra))
+    return specs
+
+
+@pytest.mark.elastic(timeout=540)
+def test_elastic_shrink_survives_rank_death(tmp_path):
+    """THE elastic acceptance drill, zero operator action end to end.
+
+    Reference leg: 3 elastic agents (one per emulated host), no faults —
+    every host's controller runs one epoch to completion and the three
+    models are byte-identical.  Chaos leg: the same pod with ``net.crash``
+    armed on host 1 only; its worker hard-exits mid-collective, the two
+    survivors negotiate a 2-rank membership epoch over the dying epoch's
+    KV store, re-deal the dead host's rows from the file, resume from the
+    last crash-safe snapshot and finish ALL ``ELASTIC_ITERS`` rounds —
+    with AUC within 2e-3 of the uninterrupted 3-rank run, the elastic
+    reliability counters ticked, and the schema-v9 telemetry ``elastic``
+    section + recovery trace spans exported."""
+    from lightgbm_tpu.observability import load_schema, validate_report
+
+    data = str(tmp_path / "train.csv")
+    X, y = _write_train_csv(data)
+
+    # -- reference: uninterrupted 3-rank elastic run
+    specs = _elastic_specs(tmp_path, 3, data, "ref")
+    pod = _run_pod(specs, timeout_s=480)
+    ref_models = {}
+    for rank, (rc, report, tail) in pod.items():
+        assert rc == 0 and report is not None and report["ok"], \
+            f"ref agent {rank} failed (rc={rc}):\n{(tail or '')[-3000:]}" \
+            f"\n{report}"
+        assert report["recoveries"] == 0
+        assert len(report["history"]) == 1
+        assert report["iterations"] == ELASTIC_ITERS
+        ref_models[rank] = report["model"]
+    assert ref_models[0] == ref_models[1] == ref_models[2]
+    bst = lgb.Booster(model_str=ref_models[0])
+    assert bst.num_trees() == ELASTIC_ITERS
+    auc_ref = _auc(y, bst.predict(X))
+    assert auc_ref > 0.8, f"reference run did not learn (AUC {auc_ref})"
+
+    # -- chaos: kill host 1's worker at its 5th collective (the re-deal
+    # allgather is #1, so this is the iteration-4 heartbeat: snapshots
+    # through iteration 3 exist).  Faults are armed via host 1's agent
+    # env ONLY, so the new rank 1 of the shrunken epoch (old host 2) is
+    # never re-killed.
+    specs = _elastic_specs(tmp_path, 3, data, "chaos",
+                           trace_out=str(tmp_path / "chaos_trace.json"))
+    specs[1]["faults"] = "net.crash:rank=1:nth=5"
+    pod = _run_pod(specs, timeout_s=480)
+
+    rc1, report1, tail1 = pod[1]
+    assert rc1 == 0 and report1 is not None, \
+        f"agent 1 itself must survive its worker (rc={rc1}):\n" \
+        f"{(tail1 or '')[-2000:]}"
+    assert report1["ok"] is False
+    assert report1["error_kind"] == "host_dead"
+    assert report1["rc"] == 17                  # net.crash hard-exit
+
+    for rank in (0, 2):
+        rc, report, tail = pod[rank]
+        assert rc == 0 and report is not None and report["ok"], \
+            f"survivor {rank} failed (rc={rc}):\n{(tail or '')[-3000:]}" \
+            f"\n{report}"
+        # one recovery, one rank lost, 3 -> 2 membership shrink
+        assert report["recoveries"] == 1
+        assert report["ranks_lost"] == 1
+        assert [e["members"] for e in report["history"]] == \
+            [[0, 1, 2], [0, 2]]
+        assert report["history"][1]["dead_hosts"] == [1]
+        # training finished ALL rounds despite the death
+        assert report["iterations"] == ELASTIC_ITERS
+        # controller-side reliability counters ticked
+        assert report["rel_counters"].get("elastic.recoveries") == 1
+        assert report["rel_counters"].get("elastic.ranks_lost") == 1
+        # the shrunken epoch's worker resumed across the topology change
+        assert report["worker_counters"].get(
+            "snapshots_resumed_after_shrink", 0) >= 1
+        assert report["worker_counters"].get("resume_runs", 0) >= 1
+        # telemetry: schema-v9 elastic section, merged by the controller
+        sec = report["report_elastic"]
+        assert sec["epochs"] == 2
+        assert sec["members"] == [0, 2]
+        assert sec["recoveries"] == 1 and sec["ranks_lost"] == 1
+        assert sec["redeal_rows"] > 0
+        assert sec["recovery_wall_s"] > 0.0
+        with open(specs[rank]["telemetry_out"]) as fh:
+            rep = json.load(fh)
+        assert rep["schema_version"] == 9
+        assert validate_report(rep, load_schema()) == []
+        assert rep["elastic"]["recoveries"] == 1
+        # controller trace: epoch spans + the recovery span
+        tpath = f"{specs[rank]['trace_out']}.elastic_h{rank}"
+        assert os.path.exists(tpath), f"missing controller trace {tpath}"
+        with open(tpath) as fh:
+            names = {ev.get("name") for ev in
+                     json.load(fh)["traceEvents"]}
+        assert "elastic.epoch" in names
+        assert "elastic.recovery" in names
+
+    # survivors trained the SAME model (full re-dealt dataset + f64
+    # accounting), and its AUC matches the uninterrupted 3-rank run
+    m0, m2 = pod[0][1]["model"], pod[2][1]["model"]
+    assert m0 == m2, "survivors diverged after the shrink"
+    auc = _auc(y, lgb.Booster(model_str=m0).predict(X))
+    assert abs(auc - auc_ref) < 2e-3, \
+        f"post-shrink AUC {auc} vs uninterrupted {auc_ref}"
+
+
+@pytest.mark.elastic(timeout=300)
+def test_elastic_below_min_ranks_is_terminal(tmp_path):
+    """A 2-host pod with ``elastic_min_ranks=2``: killing host 1 leaves a
+    1-rank membership, below the floor — the survivor's controller raises
+    the TERMINAL structured failure naming the full epoch history instead
+    of training on alone."""
+    data = str(tmp_path / "train.csv")
+    _write_train_csv(data, n=300, f=10)
+    specs = _elastic_specs(tmp_path, 2, data, "floor", min_ranks=2)
+    specs[1]["faults"] = "net.crash:rank=1:nth=2"
+    pod = _run_pod(specs, timeout_s=420)
+
+    rc1, report1, _tail1 = pod[1]
+    assert rc1 == 0 and report1 is not None
+    assert report1["error_kind"] == "host_dead" and report1["rc"] == 17
+
+    rc0, report0, tail0 = pod[0]
+    assert rc0 == 0 and report0 is not None, \
+        f"agent 0 failed (rc={rc0}):\n{(tail0 or '')[-3000:]}"
+    assert report0["ok"] is False
+    assert report0["error_kind"] == "terminal"
+    assert "below elastic_min_ranks=2" in report0["error"]
+    # the terminal failure narrates the whole shrink trajectory
+    assert "Epoch history:" in report0["error"]
+    assert [e["members"] for e in report0["history"]] == [[0, 1], [0]]
+    assert report0["history"][1]["dead_hosts"] == [1]
+    # the recovery was attempted (and counted) before the floor tripped
+    assert report0["rel_counters"].get("elastic.recoveries") == 1
+
+
+# -- elastic unit tests (in-process) -----------------------------------------
+
+def test_rank_death_error_is_connection_error():
+    """Existing ConnectionError handlers keep working; the elastic
+    controller additionally reads the typed verdict."""
+    err = multihost.RankDeathError("r1 died", dead_ranks=[1, 3], epoch=2)
+    assert isinstance(err, ConnectionError)
+    assert err.dead_ranks == [1, 3]
+    assert err.epoch == 2
+
+
+def test_membership_epoch_roundtrip():
+    from lightgbm_tpu.elastic import MembershipEpoch
+    from lightgbm_tpu.elastic.epoch import coordinator_for_epoch
+
+    e = MembershipEpoch(epoch=3, members=[0, 2, 5], dead_hosts=[1],
+                        coordinator="127.0.0.1:12424")
+    assert MembershipEpoch.from_dict(e.to_dict()) == e
+    # ranks are INDICES into the stable-host-id member list
+    assert e.rank_of(5) == 2
+    assert coordinator_for_epoch("127.0.0.1", 12421, 3) == "127.0.0.1:12424"
+
+
+def test_fingerprint_splits_semantics_from_topology():
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.reliability.resume import (config_fingerprint,
+                                                 topology_fingerprint)
+
+    base = {"objective": "binary", "num_leaves": 7, "learning_rate": 0.1}
+    a = Config.from_params(dict(base))
+    # a pure world-shape change (3 hosts -> 2 hosts, new rank): the
+    # semantic fingerprint is UNCHANGED, only the topology one moves
+    b = Config.from_params(dict(base, coordinator_address="127.0.0.1:1",
+                                num_hosts=2, process_id=1))
+    assert config_fingerprint(a) == config_fingerprint(b)
+    assert topology_fingerprint(a) != topology_fingerprint(b)
+    # a semantic change moves the config fingerprint
+    c = Config.from_params(dict(base, learning_rate=0.3))
+    assert config_fingerprint(a) != config_fingerprint(c)
+    # elastic knobs are volatile: flipping them invalidates nothing
+    d = Config.from_params(dict(base, elastic=True, elastic_epoch=4,
+                                elastic_max_recoveries=9))
+    assert config_fingerprint(a) == config_fingerprint(d)
+    assert topology_fingerprint(a) == topology_fingerprint(d)
+
+
+def test_elastic_resume_accepts_topology_change(rng, tmp_path):
+    """The satellite contract: a topology-changed snapshot is REJECTED for
+    a plain resume and accepted (warning + counter) for an elastic one."""
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.reliability.metrics import rel_get, rel_reset
+    from lightgbm_tpu.reliability.resume import find_resume_snapshot
+
+    X = rng.randn(200, 4)
+    y = (X[:, 0] > 0).astype(float)
+    base = {"objective": "binary", "num_leaves": 7, "min_data_in_leaf": 10,
+            "verbosity": -1}
+    out = str(tmp_path / "model.txt")
+    lgb.train(dict(base, output_model=out, snapshot_freq=2),
+              lgb.Dataset(X, label=y, params=dict(base)), 4,
+              verbose_eval=False)
+    # same semantics, different world shape (as after a pod shrink)
+    shrunk = dict(base, coordinator_address="127.0.0.1:1", num_hosts=2,
+                  process_id=0)
+    with pytest.warns(UserWarning, match="different topology"):
+        assert find_resume_snapshot(
+            out, Config.from_params(dict(shrunk))) is None
+    rel_reset()
+    with pytest.warns(UserWarning, match="elastic resume"):
+        found = find_resume_snapshot(
+            out, Config.from_params(dict(shrunk, elastic=True)))
+    assert found is not None and found[0] == 4
+    assert rel_get("snapshots_resumed_after_shrink") == 1
+
+
+def test_elastic_inmemory_dataset_warns_cannot_redeal(rng):
+    """The router says it LOUDLY: an in-memory Dataset under elastic=true
+    cannot re-deal rows after a shrink."""
+    X = rng.randn(50, 3)
+    y = (X[:, 0] > 0).astype(float)
+    params = {"objective": "binary", "verbosity": -1, "elastic": True}
+    with pytest.warns(RuntimeWarning, match="CANNOT re-deal"):
+        lgb.Dataset(X, label=y, params=params).construct()
+
+
+def test_telemetry_elastic_section_schema():
+    """set_elastic lands the optional v9 ``elastic`` section and the
+    report still validates against the checked-in schema."""
+    from lightgbm_tpu.observability import load_schema, validate_report
+    from lightgbm_tpu.observability.telemetry import Telemetry
+
+    tel = Telemetry(True)
+    rep = tel.report()
+    assert rep["schema_version"] == 9
+    assert "elastic" not in rep            # strictly opt-in
+    tel.set_elastic(epoch=1, members=2, recoveries=1, ranks_lost=1)
+    rep = tel.report()
+    assert rep["elastic"]["epoch"] == 1
+    assert rep["elastic"]["members"] == 2
+    assert validate_report(rep, load_schema()) == []
+
+
 # -- config resolution (in-process unit tests) ------------------------------
 
 class _Cfg:
