@@ -55,6 +55,8 @@ DEFAULT_FILES = (
     os.path.join("reliability", "metrics.py"),
     os.path.join("lifecycle", "recorder.py"),
     os.path.join("lifecycle", "controller.py"),
+    os.path.join("lifecycle", "budget.py"),
+    os.path.join("lifecycle", "autopilot.py"),
     os.path.join("observability", "trace.py"),
     os.path.join("observability", "metrics_export.py"),
     os.path.join("observability", "drift.py"),

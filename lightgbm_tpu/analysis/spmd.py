@@ -203,9 +203,10 @@ def cross_factorization_findings(traced, groups: Optional[Dict[str, Tuple[
 
 # -- LGB008: rank-divergent control flow around collectives -------------------
 
-#: the default LGB008 analysis set (ISSUE: the layers elastic recovery
-#: will touch)
-RANK_DIRS = ("parallel", "io", "boosting", "elastic")
+#: the default LGB008 analysis set (the layers elastic recovery touches,
+#: plus lifecycle/ — the autopilot daemon must stay host-only with ZERO
+#: collective sites, and this scan is what proves it)
+RANK_DIRS = ("parallel", "io", "boosting", "elastic", "lifecycle")
 
 #: call names (attribute suffixes) that ARE collective / net ops: the
 #: host-side net seams (SocketNet / DistributedNet / LoopbackNet), the

@@ -205,6 +205,13 @@ def run_serve(params: Dict[str, str], cfg: Config) -> None:
         fleet_kwargs["recovery_s"] = cfg.serve_recovery_s
         fleet_kwargs["drift_psi_threshold"] = cfg.drift_psi_threshold
         fleet_kwargs["drift_ks_threshold"] = cfg.drift_ks_threshold
+        fleet_kwargs["tenant_max_inflight"] = cfg.serve_tenant_max_inflight
+        baseline_path = cfg.drift_baseline_path
+        if not baseline_path and cfg.lifecycle_record_rows > 0:
+            # default: baselines live beside the served model artifact
+            baseline_path = cfg.input_model + ".drift_baselines.json"
+        if baseline_path and baseline_path != "off":
+            fleet_kwargs["drift_baseline_path"] = baseline_path
     server = booster.serve(
         replicas=cfg.serve_replicas,
         host=cfg.serve_host, port=cfg.serve_port,
@@ -234,6 +241,35 @@ def run_serve(params: Dict[str, str], cfg: Config) -> None:
     if cfg.lifecycle_record_rows > 0:
         _log(f"Recording the newest {cfg.lifecycle_record_rows} request "
              f"rows for lifecycle shadow validation")
+    if cfg.autopilot:
+        if not cfg.serve_replicas:
+            raise ValueError("autopilot=true requires fleet serving "
+                             "(serve_replicas != 0)")
+        if cfg.lifecycle_record_rows <= 0:
+            raise ValueError("autopilot=true requires "
+                             "lifecycle_record_rows > 0 (the drift and "
+                             "shadow window)")
+        if not cfg.data:
+            raise ValueError("autopilot=true requires data= (the "
+                             "original train source refits continue "
+                             "from)")
+        from .io.parser import load_data_file
+        from .lifecycle import Autopilot, LifecycleController
+
+        def _train_source(path=cfg.data, p=dict(params)):
+            mat, label, _, _ = load_data_file(path, p)
+            if label is None:
+                raise ValueError(f"autopilot train source {path!r} "
+                                 f"carries no label column")
+            return mat, label
+        controller = LifecycleController.from_config(server, cfg)
+        Autopilot.from_config(server, controller, _train_source, cfg,
+                              params=dict(params)).start()
+        _log(f"Autopilot armed: check every "
+             f"{cfg.autopilot_interval_s:g}s, refit after "
+             f"{cfg.autopilot_consecutive_checks} consecutive drifted "
+             f"windows, <= {cfg.autopilot_max_refits} refits per "
+             f"{cfg.autopilot_window_s:g}s window")
     try:
         server.wait()
     except KeyboardInterrupt:

@@ -516,6 +516,12 @@ class Config:
     # admission and response; the rest shed with a structured
     # {"error": "overloaded"} frame (reliability/degrade.py)
     serve_max_inflight: int = 64
+    # per-tenant admission caps (fleet gateway): at most this many
+    # in-flight requests PER model name, so one hot tenant saturates its
+    # own cap and sheds while the rest keep admitting under the global
+    # bound.  0 = derive from serve_max_inflight (a single tenant may
+    # use the whole capacity — isolation is opt-in)
+    serve_tenant_max_inflight: int = 0
     # periodic operator-pollable stats snapshots: every
     # serve_stats_interval seconds the full schema-validated telemetry
     # report is written atomically (tmp + os.replace) to serve_stats_out,
@@ -546,6 +552,32 @@ class Config:
     # p < 0.05 against the baseline captured at promote time
     drift_psi_threshold: float = 0.2
     drift_ks_threshold: float = 0.15
+    # persist captured drift baselines (atomic tmp + os.replace) so a
+    # gateway restart resumes drift detection instead of silently
+    # disabling it until the next promotion.  "" = derive from
+    # input_model (<input_model>.drift_baselines.json) when recording is
+    # on; "off" disables persistence
+    drift_baseline_path: str = ""
+    # --- autopilot (lightgbm_tpu/lifecycle/autopilot.py) ---
+    # drift-triggered refit daemon for fleet serving (task=serve with
+    # serve_replicas != 0, lifecycle_record_rows > 0 and data= pointing
+    # at the original train source).  Checks the drift verdict every
+    # autopilot_interval_s; autopilot_consecutive_checks consecutive
+    # drifted verdicts over fresh traffic trigger a refit cycle
+    # (continued training from the incumbent, shadow-validated,
+    # per-replica gated rolling upgrade) under the RefitBudget caps
+    autopilot: bool = False
+    autopilot_interval_s: float = 30.0
+    autopilot_consecutive_checks: int = 3
+    autopilot_num_boost_round: int = 10
+    # RefitBudget (lifecycle/budget.py): at most autopilot_max_refits
+    # refit starts per rolling autopilot_window_s, at least
+    # autopilot_min_spacing_s between starts, and a
+    # autopilot_cooldown_s freeze after any rollback
+    autopilot_max_refits: int = 4
+    autopilot_window_s: float = 3600.0
+    autopilot_min_spacing_s: float = 60.0
+    autopilot_cooldown_s: float = 300.0
     # --- lifecycle (lightgbm_tpu/lifecycle/) ---
     # bounded live-traffic ring in the serving server: the newest this
     # many request feature rows are retained for the lifecycle shadow

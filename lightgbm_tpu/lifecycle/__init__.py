@@ -12,16 +12,27 @@ watchdog rolls back automatically when serving health regresses.
   * ``shadow``     — gated candidate-vs-incumbent replay
   * ``controller`` — ``LifecycleController``: refit → shadow → promote →
     watch, with every decision in the ``lifecycle`` telemetry section
+  * ``budget``     — ``RefitBudget``: rate caps for autonomous refits
+    (window cap, min spacing, cooldown-after-rollback, one-at-a-time)
+  * ``autopilot``  — ``Autopilot``: the daemon that closes the loop —
+    sustained drift verdicts trigger a budgeted refit cycle and a
+    per-replica shadow-gated rolling upgrade (schema-v10 ``autopilot``
+    report section)
 
 Chaos-testable end to end: ``train.crash`` kills a refit mid-run (resume
 is bit-identical), ``serve.predict.fail`` after a promotion drives the
-watchdog's automatic rollback (`tests/test_lifecycle.py`).
+watchdog's automatic rollback (`tests/test_lifecycle.py`), and the soak
+drill (`tests/test_soak.py`) runs the full detect→refit→validate→promote
+loop against a faulted 2-replica fleet.
 """
 
+from .autopilot import Autopilot
+from .budget import RefitBudget
 from .controller import (CandidateRejected, LifecycleController,
                          RollbackWatchdog)
 from .recorder import TrafficRecorder
 from .shadow import shadow_validate
 
 __all__ = ["LifecycleController", "RollbackWatchdog", "CandidateRejected",
-           "TrafficRecorder", "shadow_validate"]
+           "TrafficRecorder", "shadow_validate", "Autopilot",
+           "RefitBudget"]

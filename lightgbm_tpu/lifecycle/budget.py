@@ -1,0 +1,120 @@
+"""Refit budget: rate limits for autonomous model refits.
+
+An autopilot that refits whenever drift looks sustained can still melt a
+fleet: a pathological feature pipeline yields a permanently-drifted
+verdict, every check triggers a refit, and the serving host spends its
+CPU on training instead of inference.  ``RefitBudget`` is the single
+choke point every autopilot cycle must pass:
+
+  * **window cap** — at most ``max_refits_per_window`` refit *starts*
+    inside any rolling ``window_s`` span (failed cycles count: they
+    spent the compute);
+  * **min spacing** — at least ``min_spacing_s`` between consecutive
+    starts, so back-to-back drift verdicts cannot stack cycles;
+  * **cooldown after rollback** — a cycle that ended in a rollback
+    (shadow-gate abort mid-roll, watchdog breach) freezes refits for
+    ``cooldown_s``: if the last candidate regressed, the same training
+    recipe will likely regress again until the window moves on;
+  * **concurrency** — a hard one-at-a-time lock; a second trigger while
+    a cycle is running is suppressed, never queued.
+
+The budget never blocks: ``try_begin`` either admits the cycle or
+returns a machine-readable suppression reason the caller records.  Pure
+host-side bookkeeping under one leaf lock — no JAX, no collectives.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, Optional, Tuple
+
+__all__ = ["RefitBudget"]
+
+
+class RefitBudget:
+    """Admission control for autopilot refit cycles (see module doc)."""
+
+    def __init__(self, max_refits_per_window: int = 4,
+                 window_s: float = 3600.0,
+                 min_spacing_s: float = 60.0,
+                 cooldown_s: float = 300.0):
+        self.max_refits_per_window = max(int(max_refits_per_window), 1)
+        self.window_s = float(window_s)
+        self.min_spacing_s = float(min_spacing_s)
+        self.cooldown_s = float(cooldown_s)
+        self._lock = threading.Lock()
+        self._starts: list = []          # monotonic stamps, newest last
+        self._last_start: Optional[float] = None
+        self._cooldown_until = 0.0
+        self._active = False
+        self._admitted = 0
+        self._suppressed: Dict[str, int] = {}
+
+    # -- admission -----------------------------------------------------
+
+    def try_begin(self) -> Tuple[bool, str]:
+        """Admit one refit cycle or return ``(False, reason)``.
+
+        On success the caller OWNS the budget's concurrency slot and
+        must call :meth:`end` exactly once, however the cycle ends.
+        """
+        now = time.monotonic()
+        with self._lock:
+            reason = self._veto(now)
+            if reason:
+                self._suppressed[reason] = self._suppressed.get(reason, 0) + 1
+                return False, reason
+            self._active = True
+            self._last_start = now
+            self._starts.append(now)
+            self._admitted += 1
+            return True, ""
+
+    def _veto(self, now: float) -> str:
+        """Reason the cycle must not start, or '' — caller holds the
+        lock."""
+        if self._active:
+            return "concurrent_refit"
+        if now < self._cooldown_until:
+            return "cooldown"
+        if self._last_start is not None and \
+                now - self._last_start < self.min_spacing_s:
+            return "min_spacing"
+        self._starts = [t for t in self._starts
+                        if now - t < self.window_s]
+        if len(self._starts) >= self.max_refits_per_window:
+            return "window_exhausted"
+        return ""
+
+    def end(self, rolled_back: bool = False) -> None:
+        """Release the concurrency slot; a rollback arms the cooldown."""
+        with self._lock:
+            self._active = False
+            if rolled_back:
+                self._cooldown_until = time.monotonic() + self.cooldown_s
+
+    def note_rollback(self) -> None:
+        """An out-of-band rollback (operator, watchdog) also cools the
+        autopilot down — the serving window just proved hostile."""
+        with self._lock:
+            self._cooldown_until = time.monotonic() + self.cooldown_s
+
+    # -- introspection -------------------------------------------------
+
+    def section(self) -> Dict[str, Any]:
+        now = time.monotonic()
+        with self._lock:
+            in_window = len([t for t in self._starts
+                             if now - t < self.window_s])
+            return {
+                "max_refits_per_window": self.max_refits_per_window,
+                "window_s": self.window_s,
+                "min_spacing_s": self.min_spacing_s,
+                "cooldown_s": self.cooldown_s,
+                "refits_in_window": in_window,
+                "admitted": self._admitted,
+                "active": self._active,
+                "in_cooldown": now < self._cooldown_until,
+                "suppressed": dict(self._suppressed),
+            }

@@ -320,6 +320,16 @@ class LifecycleController:
         rel_inc("lifecycle.promotions")
         self._event("promote", version=int(version))
         if watch:
+            stale = self.watchdog
+            if stale is not None:
+                # Back-to-back promotions: the previous watchdog's
+                # ServingStats base belongs to the OLD candidate's
+                # window — left running it would judge the new candidate
+                # against stale error/shed deltas and could roll it back
+                # spuriously.  The replacement watchdog re-baselines in
+                # its own __init__.
+                stale.cancel()
+                stale.join(timeout=5.0)
             self.watchdog = RollbackWatchdog(
                 self, version, self.rollback_deadline_s,
                 self.watch_interval_s, self.error_rate_max,

@@ -198,6 +198,37 @@ class _Baseline:
         return np.bincount(idx, minlength=len(self.score_edges) + 1
                            ).astype(np.int64)
 
+    # -- persistence (baselines survive a gateway restart) ------------
+
+    def to_dict(self) -> Dict[str, Any]:
+        """JSON-serializable form — exactly the ``__slots__`` state, so
+        a restored baseline is indistinguishable from the captured
+        one."""
+        return {
+            "model_name": self.model_name,
+            "version": self.version,
+            "rows": self.rows,
+            "feature_counts": [c.tolist() for c in self.feature_counts],
+            "feature_names": list(self.feature_names),
+            "score_sample": self.score_sample.tolist(),
+            "score_edges": self.score_edges.tolist(),
+            "score_counts": self.score_counts.tolist(),
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "_Baseline":
+        base = cls.__new__(cls)
+        base.model_name = str(d["model_name"])
+        base.version = int(d["version"])
+        base.rows = int(d["rows"])
+        base.feature_counts = [np.asarray(c, np.int64)
+                               for c in d["feature_counts"]]
+        base.feature_names = [str(n) for n in d["feature_names"]]
+        base.score_sample = np.asarray(d["score_sample"], np.float64)
+        base.score_edges = np.asarray(d["score_edges"], np.float64)
+        base.score_counts = np.asarray(d["score_counts"], np.int64)
+        return base
+
 
 class DriftMonitor:
     """Baseline-vs-window drift checks keyed by model name.
@@ -242,6 +273,46 @@ class DriftMonitor:
     def has_baseline(self, name: str = "default") -> bool:
         with self._lock:
             return name in self._baselines
+
+    # -- persistence ---------------------------------------------------------
+
+    def save(self, path: str) -> int:
+        """Atomic write (tmp + ``os.replace``, rule LGB002) of every
+        captured baseline so a restarted gateway resumes drift
+        detection without waiting for the next promotion.  Returns the
+        number of baselines written."""
+        import json
+        import os
+        with self._lock:
+            data = {n: b.to_dict() for n, b in self._baselines.items()}
+        tmp = path + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump({"drift_baselines": data}, fh)
+        os.replace(tmp, path)
+        return len(data)
+
+    def restore(self, path: str) -> int:
+        """Load baselines written by :meth:`save`.  In-memory baselines
+        win (a live capture is fresher than anything on disk); each
+        restored entry counts on ``drift.baseline_restored``.  Returns
+        the number restored; 0 when the file does not exist."""
+        import json
+        import os
+        from ..reliability.metrics import rel_inc
+        if not os.path.exists(path):
+            return 0
+        with open(path) as fh:
+            data = json.load(fh).get("drift_baselines", {})
+        restored = 0
+        with self._lock:
+            for name, d in data.items():
+                if name in self._baselines:
+                    continue
+                self._baselines[name] = _Baseline.from_dict(d)
+                restored += 1
+        if restored:
+            rel_inc("drift.baseline_restored", restored)
+        return restored
 
     # -- check ---------------------------------------------------------------
 

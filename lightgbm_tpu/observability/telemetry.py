@@ -71,7 +71,12 @@ TEL_NAMES = {
 # the engine on elastic pods; the per-host controller merges the recovery
 # totals — epochs, recoveries, ranks_lost, re-dealt row count, recovery
 # wall-time — into the final report, `lightgbm_tpu/elastic/controller.py`)
-SCHEMA_VERSION = 9
+# v10: optional "autopilot" section (drift-triggered refit daemon: check /
+# trigger / suppress / promote / rollback counts, the RefitBudget state and
+# the bounded decision history — `lightgbm_tpu/lifecycle/autopilot.py`);
+# serving.tenants[] items gain "tenant_shed" (sheds by the tenant's OWN
+# admission cap, `reliability/degrade.py` TenantAdmission)
+SCHEMA_VERSION = 10
 
 
 def provenance_section(extra: Optional[Dict[str, Any]] = None

@@ -54,7 +54,7 @@ class TenantStats:
     counters only and nothing is called while holding it."""
 
     __slots__ = ("name", "hist", "_lock", "requests", "errors", "shed",
-                 "within_slo")
+                 "tenant_shed", "within_slo")
 
     def __init__(self, name: str):
         self.name = name
@@ -63,6 +63,7 @@ class TenantStats:
         self.requests = 0
         self.errors = 0
         self.shed = 0
+        self.tenant_shed = 0    # shed by THIS tenant's own cap
         self.within_slo = 0
 
     def record(self, ms: float, slo_p99_ms: float,
@@ -79,6 +80,13 @@ class TenantStats:
         with self._lock:
             self.shed += 1
 
+    def record_cap_shed(self) -> None:
+        """A shed caused by this tenant's OWN admission cap (the global
+        gate still had headroom) — the isolation signal per-tenant
+        admission control exists to surface."""
+        with self._lock:
+            self.tenant_shed += 1
+
     def record_error(self) -> None:
         """An error WITHOUT a latency sample — the control-plane path
         (failed swap, unknown op), so the tenant's error rate sees every
@@ -93,12 +101,17 @@ class TenantStats:
         with self._lock:
             requests, errors = self.requests, self.errors
             shed, within = self.shed, self.within_slo
-        attainment = within / requests if requests else 1.0
+            tenant_shed = self.tenant_shed
+        # a request this tenant's own cap refused was offered work that
+        # never met the SLO: tenant-local sheds burn the error budget
+        offered = requests + tenant_shed
+        attainment = within / offered if offered else 1.0
         budget = max(1.0 - float(slo_target), 1e-9)
         return {"model": self.name,
                 "requests": requests,
                 "errors": errors,
                 "shed": shed,
+                "tenant_shed": tenant_shed,
                 "latency_ms": latency,
                 "slo": {"p99_target_ms": float(slo_p99_ms),
                         "target": float(slo_target),
@@ -225,6 +238,11 @@ class ServingStats:
 
     def record_tenant_shed(self, name: str) -> None:
         self.tenant(name).record_shed()
+
+    def record_tenant_cap_shed(self, name: str) -> None:
+        """A shed by the tenant's own admission cap, not the global one
+        (`reliability.degrade.TenantAdmission`)."""
+        self.tenant(name).record_cap_shed()
 
     def record_tenant_error(self, name: str) -> None:
         """Control-plane failure attributed to a tenant (no latency

@@ -58,7 +58,7 @@ from ...lifecycle.recorder import TrafficRecorder
 from ...lifecycle.shadow import shadow_validate
 from ...observability.drift import DriftMonitor
 from ...observability.trace import TraceRecorder, new_trace_id
-from ...reliability.degrade import AdmissionController
+from ...reliability.degrade import AdmissionController, TenantAdmission
 from ...reliability.metrics import rel_inc
 from ..batcher import ServingStats
 from . import wire
@@ -102,13 +102,20 @@ class FleetServer:
                  slo_p99_ms: float = 50.0, slo_target: float = 0.99,
                  drift_psi_threshold: float = 0.2,
                  drift_ks_threshold: float = 0.15,
-                 drift_min_rows: int = 32):
+                 drift_min_rows: int = 32,
+                 tenant_max_inflight: int = 0,
+                 drift_baseline_path: str = ""):
         self.host = host
         self.port = int(port)
         self.request_timeout = float(request_timeout)
         self.max_frame_bytes = int(max_frame_bytes)
         self.telemetry_out = telemetry_out
         self.admission = AdmissionController(max_inflight)
+        # per-tenant caps (0 = derive from the global cap: a single
+        # tenant may use the whole capacity; set lower to isolate)
+        self.tenant_admission = TenantAdmission(
+            tenant_max_inflight if tenant_max_inflight > 0
+            else max_inflight)
         self.stats = ServingStats(slo_p99_ms=slo_p99_ms,
                                   slo_target=slo_target)
         self.tracer: Optional[TraceRecorder] = None
@@ -127,7 +134,19 @@ class FleetServer:
                                   ks_threshold=drift_ks_threshold,
                                   min_rows=drift_min_rows,
                                   tracer=self.tracer)
+        # baselines persisted alongside the model artifact survive a
+        # gateway restart — without this, a restart silently disables
+        # drift detection until the next promotion recaptures
+        self.drift_baseline_path = drift_baseline_path
+        if drift_baseline_path and self.recorder.enabled:
+            try:
+                self.drift.restore(drift_baseline_path)
+            except Exception as e:
+                rel_inc("drift.baseline_restore_errors")
+                print(f"[LightGBM-TPU] [Warning] drift baseline restore "
+                      f"failed: {e}", flush=True)
         self.lifecycle = None
+        self.autopilot = None
         self.replicas = ReplicaSet(
             stats=self.stats, replicas=replicas,
             max_batch_rows=max_batch_rows, deadline_ms=deadline_ms,
@@ -180,6 +199,10 @@ class FleetServer:
         if self._stop.is_set():
             return
         self._stop.set()
+        if self.autopilot is not None:
+            self.autopilot.stop()
+        if self.lifecycle is not None:
+            self.lifecycle.stop()
         self._wake()
         if self._thread is not None:
             self._thread.join(timeout=5.0)
@@ -210,10 +233,20 @@ class FleetServer:
         rep["serving"]["replicas"] = self.replicas.section()
         if self.lifecycle is not None:
             rep["lifecycle"] = self.lifecycle.section()
+        if self.autopilot is not None:
+            rep["autopilot"] = self.autopilot.section()
         drift = self.check_drift()
         if drift is not None:
             rep["drift"] = drift
         return rep
+
+    @property
+    def registry(self):
+        """Replica 0's registry — the fleet's canonical view, letting
+        ``LifecycleController`` (built for the single-registry server)
+        bind to a fleet for refit/shadow; promotion goes through
+        ``promote_rolling``, never through this registry alone."""
+        return self.replicas.replicas[0].registry
 
     # -- drift monitoring ----------------------------------------------------
 
@@ -229,7 +262,22 @@ class FleetServer:
             model = self.replicas.get(name)
         except KeyError:
             return False
-        return self.drift.capture(model, self.recorder.snapshot())
+        captured = self.drift.capture(model, self.recorder.snapshot())
+        if captured:
+            self._persist_drift_baselines()
+        return captured
+
+    def _persist_drift_baselines(self) -> None:
+        """Atomic save (tmp + ``os.replace``) of every captured baseline
+        so a restarted gateway resumes drift detection immediately."""
+        if not self.drift_baseline_path:
+            return
+        try:
+            self.drift.save(self.drift_baseline_path)
+        except Exception as e:
+            rel_inc("drift.baseline_persist_errors")
+            print(f"[LightGBM-TPU] [Warning] drift baseline save "
+                  f"failed: {e}", flush=True)
 
     def check_drift(self, name: str = "default",
                     drain: bool = False) -> Optional[Dict[str, Any]]:
@@ -275,13 +323,17 @@ class FleetServer:
                         divergence_max: float = 0.25,
                         latency_max_ratio: float = 8.0,
                         shadow_min_rows: int = 1) -> Dict[str, Any]:
-        """Fleet-wide promotion: prepare (build+warm+verify) the
-        candidate on EVERY replica off to the side, gate replica 0's
-        prepared copy with the shadow validator over the recorded
-        traffic window, then commit one replica at a time.  Serving is
-        never interrupted: each commit is an atomic registry swap and
+        """Fleet-wide promotion with a PER-REPLICA shadow gate: prepare
+        (build+warm+verify) the candidate on EVERY replica off to the
+        side, then commit one replica at a time, re-running the shadow
+        validator on THAT replica's prepared copy against its own
+        incumbent immediately before its swap.  A gate failure at
+        replica 0 commits nothing; a failure mid-roll aborts and
+        reverse-rolls the already-committed replicas, leaving the fleet
+        homogeneous on the incumbent.  Serving is never interrupted:
+        each commit (and each rollback) is an atomic registry swap and
         batchers resolve their model per batch.  Returns the structured
-        outcome; a failed gate commits nothing."""
+        outcome with every gate's report."""
         with self._promote_lock:
             prepared = self.replicas.prepare_all(
                 name, booster=booster, model_str=model_str,
@@ -289,35 +341,55 @@ class FleetServer:
             out: Dict[str, Any] = {"model": name,
                                    "replicas": len(self.replicas)}
             X = self.recorder.snapshot()
-            incumbent = None
-            try:
-                incumbent = self.replicas.get(name)
-            except KeyError:
-                pass
-            if incumbent is not None and X.shape[0] >= shadow_min_rows \
-                    and X.size:
-                shadow = shadow_validate(
-                    prepared[0], incumbent, X,
-                    divergence_max=divergence_max,
+            rows = int(X.shape[0]) if X.size else 0
+            incumbents: Dict[int, Any] = {}
+            for r in self.replicas.replicas:
+                try:
+                    incumbents[r.index] = r.registry.get(name)
+                except KeyError:
+                    pass
+            gate_active = X.size and rows >= shadow_min_rows
+
+            def _gate(index, model):
+                inc = incumbents.get(index)
+                if inc is None or not gate_active:
+                    return True, {"skipped": True, "rows": rows}
+                rep = shadow_validate(
+                    model, inc, X, divergence_max=divergence_max,
                     latency_max_ratio=latency_max_ratio,
                     min_rows=shadow_min_rows, buckets=self.buckets)
-                out["shadow"] = shadow
-                if not shadow["passed"]:
-                    out["committed"] = False
-                    rel_inc("serve.fleet_promotions_rejected")
-                    return out
-            else:
-                out["shadow"] = {"skipped": True,
-                                 "rows": int(X.shape[0]) if X.size else 0}
-            out["versions"] = self.replicas.commit_rolling(
-                prepared, settle_s=settle_s)
-            out["committed"] = True
+                return bool(rep["passed"]), rep
+
+            roll = self.replicas.commit_rolling_gated(
+                prepared, _gate, settle_s=settle_s, name=name)
+            out["gates"] = [{"replica": g["replica"],
+                             "passed": g["passed"]}
+                            for g in roll["gates"]]
+            out["shadow"] = (roll["gates"][0]["report"] if roll["gates"]
+                             else {"skipped": True, "rows": rows})
+            out["versions"] = roll["versions"]
+            out["committed"] = roll["committed"]
+            if not roll["committed"]:
+                out["aborted_replica"] = roll["aborted_replica"]
+                out["restored"] = roll["restored"]
+                # mid-roll abort (something already committed, now
+                # reverse-rolled) vs a clean replica-0 rejection
+                rel_inc("serve.fleet_promotions_aborted"
+                        if roll["restored"]
+                        else "serve.fleet_promotions_rejected")
+                if self.tracer is not None:
+                    self.tracer.instant(
+                        "fleet.roll_abort",
+                        args={"model": name,
+                              "replica": str(roll["aborted_replica"])})
+                return out
             rel_inc("serve.fleet_promotions")
             # the traffic the new version was judged on becomes its
             # drift baseline: later windows are compared against the
             # distribution that was live at promote time
             if self.recorder.enabled and X.size:
                 out["drift_baseline"] = self.drift.capture(prepared[0], X)
+                self._persist_drift_baselines()
             return out
 
     def rollback_fleet(self, name: str = "default") -> Dict[str, Any]:
@@ -492,7 +564,9 @@ class FleetServer:
                 wire.FLAG_RESP, trace_id)
         if resp.get("shed"):
             return wire.shed_frame(resp.get("inflight", 0),
-                                   resp.get("capacity", 0), trace_id)
+                                   resp.get("capacity", 0), trace_id,
+                                   model=resp.get("model", ""),
+                                   scope=resp.get("scope", ""))
         if not resp.get("ok", True):
             return wire.error_frame(str(resp.get("error")), trace_id)
         body = {k: v for k, v in resp.items() if k != "ok"}
@@ -708,8 +782,25 @@ class FleetServer:
             self.stats.record_shed()
             self.stats.record_tenant_shed(name)
             resp = {"ok": False, "error": "overloaded", "shed": True,
+                    "model": name,
                     "inflight": self.admission.inflight,
                     "capacity": self.admission.capacity}
+            if tid:
+                resp["trace_id"] = tid
+            self._send_bytes(conn, self._encode_resp(conn, resp, opcode,
+                                                     tid))
+            return
+        if not self.tenant_admission.try_acquire(name):
+            # over THIS tenant's cap while the gateway still has global
+            # headroom: shed the hot tenant, the rest keep admitting
+            self.admission.release()
+            self.stats.record_shed()
+            self.stats.record_tenant_shed(name)
+            self.stats.record_tenant_cap_shed(name)
+            resp = {"ok": False, "error": "overloaded", "shed": True,
+                    "model": name, "scope": "tenant",
+                    "inflight": self.tenant_admission.inflight(name),
+                    "capacity": self.tenant_admission.capacity}
             if tid:
                 resp["trace_id"] = tid
             self._send_bytes(conn, self._encode_resp(conn, resp, opcode,
@@ -743,6 +834,7 @@ class FleetServer:
                     self._send_bytes(conn, self._encode_resp(
                         conn, resp, opcode, tid))
                 finally:
+                    self.tenant_admission.release(name)
                     self.admission.release()
                     ms = (time.perf_counter() - t0) * 1e3
                     self.stats.record_request_latency(ms)
@@ -753,8 +845,9 @@ class FleetServer:
                 replica.submit_async(X, name, _done, trace_id=tid or None)
         except Exception as e:
             # dispatch-time failure (unknown model, bad shape): the
-            # admission slot releases HERE because no callback will
+            # admission slots release HERE because no callback will
             self.stats.record_error()
+            self.tenant_admission.release(name)
             self.admission.release()
             ms = (time.perf_counter() - t0) * 1e3
             self.stats.record_request_latency(ms)

@@ -321,6 +321,43 @@ class ReplicaSet:
                 time.sleep(settle_s)
         return versions
 
+    def commit_rolling_gated(self, prepared: Sequence[ServingModel],
+                             gate: Callable[[int, ServingModel],
+                                            Any],
+                             settle_s: float = 0.0,
+                             name: str = "default") -> Dict[str, Any]:
+        """``commit_rolling`` with an admission gate in front of EVERY
+        replica's commit (not just replica 0's): ``gate(index, model)``
+        returns ``(passed, report)`` and runs immediately before that
+        replica would swap.  The first failing gate aborts the roll and
+        reverse-rolls the replicas already committed (each registry's
+        retained incumbent swaps back, newest-committed first), leaving
+        the fleet homogeneous on the old version.  Requests in flight
+        during an abort ride whichever version their replica holds at
+        batch-resolve time — old or new, never neither."""
+        versions: Dict[int, int] = {}
+        gates: List[Dict[str, Any]] = []
+        committed: List[Replica] = []
+        for r, model in zip(self.replicas, prepared):
+            passed, report = gate(r.index, model)
+            gates.append({"replica": r.index, "passed": bool(passed),
+                          "report": report})
+            if not passed:
+                restored: Dict[int, int] = {}
+                for rc in reversed(committed):
+                    restored[rc.index] = rc.registry.rollback(name)
+                rel_inc("serve.fleet_roll_aborts")
+                return {"committed": False, "aborted_replica": r.index,
+                        "versions": versions, "gates": gates,
+                        "restored": restored}
+            versions[r.index] = r.registry.commit(model)
+            committed.append(r)
+            rel_inc("serve.fleet_rolling_commits")
+            if settle_s > 0 and r is not self.replicas[-1]:
+                time.sleep(settle_s)
+        return {"committed": True, "aborted_replica": None,
+                "versions": versions, "gates": gates, "restored": {}}
+
     def rollback_all(self, name: str = "default") -> Dict[int, int]:
         """Re-swap every replica's retained incumbent (reverse rolling
         order, matching how far a partial roll got)."""

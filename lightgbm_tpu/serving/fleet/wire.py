@@ -222,12 +222,19 @@ def error_frame(message: str, trace_id: str = "") -> bytes:
                       FLAG_RESP, trace_id)
 
 
-def shed_frame(inflight: int, capacity: int, trace_id: str = "") -> bytes:
-    return pack_frame(
-        OP_SHED,
-        encode_json({"error": "overloaded", "shed": True,
-                     "inflight": int(inflight), "capacity": int(capacity)}),
-        FLAG_RESP, trace_id)
+def shed_frame(inflight: int, capacity: int, trace_id: str = "",
+               model: str = "", scope: str = "") -> bytes:
+    """Structured overload answer.  ``model`` names the shed tenant and
+    ``scope`` distinguishes a per-tenant-cap shed (``"tenant"``) from a
+    global-capacity one (empty), so clients and log scrapers can tell
+    WHOSE budget burned."""
+    body = {"error": "overloaded", "shed": True,
+            "inflight": int(inflight), "capacity": int(capacity)}
+    if model:
+        body["model"] = model
+    if scope:
+        body["scope"] = scope
+    return pack_frame(OP_SHED, encode_json(body), FLAG_RESP, trace_id)
 
 
 def response_to_dict(opcode: int, flags: int, trace_id: str,

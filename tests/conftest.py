@@ -59,7 +59,8 @@ def pytest_runtest_call(item):
         or item.get_closest_marker("chaos") \
         or item.get_closest_marker("analysis") \
         or item.get_closest_marker("lifecycle") \
-        or item.get_closest_marker("elastic")
+        or item.get_closest_marker("elastic") \
+        or item.get_closest_marker("soak")
     if marker is None or not hasattr(signal, "SIGALRM"):
         yield
         return

@@ -1,0 +1,367 @@
+"""Autopilot suppression paths (`lightgbm_tpu/lifecycle/autopilot.py`).
+
+The soak drill (`test_soak.py`) proves the happy path end to end; this
+file pins the paths where the autopilot must do NOTHING, or fail
+safely: drift below the consecutive threshold, budget vetoes (window
+cap / spacing / cooldown / concurrency), an empty recorder window, a
+shadow-rejected candidate, and a refit killed mid-run — in every case
+the fleet keeps serving the incumbent, the budget lock is released and
+the decision lands in the report ring instead of an exception.
+"""
+
+import glob
+import os
+import time
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.lifecycle import (Autopilot, CandidateRejected,
+                                    LifecycleController, RefitBudget)
+from lightgbm_tpu.observability import validate_report
+from lightgbm_tpu.reliability import faults, list_snapshots, rel_get, rel_reset
+from lightgbm_tpu.serving import ServingClient
+
+pytestmark = pytest.mark.lifecycle
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.disarm()
+    rel_reset()
+    yield
+    faults.disarm()
+    rel_reset()
+
+
+_P = {"objective": "binary", "num_leaves": 7, "min_data_in_leaf": 10,
+      "verbosity": -1}
+
+
+def _data(rng, n=500):
+    X = rng.randn(n, 4)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(float)
+    return X, y
+
+
+def _label(X):
+    X = np.asarray(X)
+    return (X[:, 0] + 0.5 * X[:, 1] > 0).astype(float)
+
+
+def _drifted(X):
+    Xd = np.array(X, copy=True)
+    Xd[:, 0] += 6.0
+    return Xd
+
+
+def _train(X, y, rounds=5, **extra):
+    p = dict(_P, **extra)
+    return lgb.train(dict(p), lgb.Dataset(X, label=y, params=dict(p)),
+                     rounds, verbose_eval=False)
+
+
+def _fleet(bst, **kw):
+    kw.setdefault("replicas", 2)
+    kw.setdefault("port", 0)
+    kw.setdefault("max_batch_rows", 64)
+    kw.setdefault("min_bucket", 32)
+    # record_rows == one _traffic call, so each phase fully replaces the
+    # window (no clean/drifted mixtures blurring the verdict)
+    kw.setdefault("record_rows", 96)
+    kw.setdefault("drift_min_rows", 32)
+    return bst.serve(**kw)
+
+
+def _traffic(server, X, rows=96):
+    with ServingClient(server.host, server.port) as c:
+        for ofs in range(0, rows, 32):
+            c.predict(X[ofs:ofs + 32])
+
+
+def _ctl(server, **kw):
+    # generous gates: suppression must come from the autopilot's own
+    # threshold/budget logic, never from an accidental shadow rejection
+    kw.setdefault("divergence_max", 10.0)
+    kw.setdefault("latency_max_ratio", 100.0)
+    kw.setdefault("min_shadow_rows", 1)
+    return LifecycleController(server, **kw)
+
+
+def _budget(**kw):
+    kw.setdefault("max_refits_per_window", 8)
+    kw.setdefault("window_s", 600.0)
+    kw.setdefault("min_spacing_s", 0.0)
+    kw.setdefault("cooldown_s", 600.0)
+    return RefitBudget(**kw)
+
+
+def _autopilot(server, ctl, X0, y0, **kw):
+    kw.setdefault("consecutive_checks", 2)
+    kw.setdefault("budget", _budget())
+    kw.setdefault("num_boost_round", 3)
+    kw.setdefault("params", dict(_P))
+    kw.setdefault("label_fn", _label)
+    return Autopilot(server, ctl, lambda: (X0, y0), **kw)
+
+
+# -- budget unit semantics ---------------------------------------------------
+
+def test_refit_budget_veto_order_and_accounting():
+    b = RefitBudget(max_refits_per_window=4, window_s=600.0,
+                    min_spacing_s=300.0, cooldown_s=500.0)
+    ok, why = b.try_begin()
+    assert ok and why == ""
+    # one refit at a time beats every other veto
+    ok, why = b.try_begin()
+    assert not ok and why == "concurrent_refit"
+    b.end()
+    # a clean finish arms min-spacing, not cooldown
+    ok, why = b.try_begin()
+    assert not ok and why == "min_spacing"
+    sec = b.section()
+    assert sec["admitted"] == 1 and sec["active"] is False
+    assert sec["suppressed"] == {"concurrent_refit": 1, "min_spacing": 1}
+
+    # rollback arms the (longer) cooldown
+    b2 = RefitBudget(max_refits_per_window=4, window_s=600.0,
+                     min_spacing_s=0.0, cooldown_s=500.0)
+    ok, _ = b2.try_begin()
+    assert ok
+    b2.end(rolled_back=True)
+    ok, why = b2.try_begin()
+    assert not ok and why == "cooldown"
+    assert b2.section()["in_cooldown"] is True
+
+    # window cap: N admissions per sliding window, then exhausted
+    b3 = RefitBudget(max_refits_per_window=2, window_s=600.0,
+                     min_spacing_s=0.0, cooldown_s=0.0)
+    for _ in range(2):
+        ok, _ = b3.try_begin()
+        assert ok
+        b3.end()
+    ok, why = b3.try_begin()
+    assert not ok and why == "window_exhausted"
+    assert b3.section()["refits_in_window"] == 2
+
+
+def test_refit_budget_window_slides():
+    b = RefitBudget(max_refits_per_window=1, window_s=0.2,
+                    min_spacing_s=0.0, cooldown_s=0.0)
+    ok, _ = b.try_begin()
+    assert ok
+    b.end()
+    ok, why = b.try_begin()
+    assert not ok and why == "window_exhausted"
+    time.sleep(0.25)                     # the old start ages out
+    ok, _ = b.try_begin()
+    assert ok
+    b.end()
+
+
+# -- below-threshold drift never refits --------------------------------------
+
+def test_below_threshold_drift_never_refits(rng):
+    X, y = _data(rng)
+    server = _fleet(_train(X, y))
+    ap = None
+    try:
+        ap = _autopilot(server, _ctl(server), X, y, consecutive_checks=3)
+        _traffic(server, X)
+        assert server.capture_drift_baseline()
+        Xd = _drifted(X)
+
+        _traffic(server, Xd)
+        d1 = ap.tick()
+        assert d1["decision"] == "drift_pending" and d1["consecutive"] == 1
+        _traffic(server, Xd)
+        d2 = ap.tick()
+        assert d2["decision"] == "drift_pending" and d2["consecutive"] == 2
+
+        # two of three required: nothing was trained, nothing promoted
+        sec = ap.section()
+        assert sec["triggered"] == 0 and sec["promoted"] == 0
+        assert server.replicas.versions() == {"default": 1}
+        assert rel_get("lifecycle.autopilot.triggered") == 0
+        assert rel_get("lifecycle.refits") == 0
+
+        # stale window (no fresh traffic since the last verdict): the
+        # tick is a no-op, it never re-counts the same window
+        assert ap.tick() is None
+        assert ap.section()["drift_consecutive"] == 2
+    finally:
+        server.stop()
+
+
+def test_clear_verdict_resets_consecutive(rng):
+    X, y = _data(rng)
+    server = _fleet(_train(X, y))
+    try:
+        ap = _autopilot(server, _ctl(server), X, y, consecutive_checks=2)
+        _traffic(server, X)
+        assert server.capture_drift_baseline()
+
+        _traffic(server, _drifted(X))
+        assert ap.tick()["decision"] == "drift_pending"
+        # a clean window in between disarms the streak entirely
+        _traffic(server, X)
+        assert ap.tick() is None
+        assert ap.section()["drift_consecutive"] == 0
+        # drift again: the count restarts at 1, still below threshold
+        _traffic(server, _drifted(X))
+        assert ap.tick()["decision"] == "drift_pending"
+        assert ap.section()["triggered"] == 0
+        assert server.replicas.versions() == {"default": 1}
+    finally:
+        server.stop()
+
+
+# -- budget exhaustion suppresses (with the reason on the record) ------------
+
+def test_budget_exhausted_suppresses_refit(rng):
+    X, y = _data(rng)
+    server = _fleet(_train(X, y))
+    try:
+        ap = _autopilot(server, _ctl(server), X, y, consecutive_checks=1,
+                        budget=_budget(max_refits_per_window=1,
+                                       cooldown_s=0.0))
+        _traffic(server, X)
+        assert server.capture_drift_baseline()
+
+        # first sustained drift: the one budgeted refit promotes fleet-wide
+        _traffic(server, _drifted(X))
+        d = ap.tick()
+        assert d["decision"] == "promoted", d
+        assert server.replicas.versions() == {"default": 2}
+        assert all(s["models"] == {"default": 2}
+                   for s in server.replicas.section())
+
+        # promotion re-captured the baseline over the drifted window, so
+        # traffic at the ORIGINAL distribution now reads as drift again —
+        # but the budget window is spent
+        _traffic(server, X)
+        d = ap.tick()
+        assert d["decision"] == "suppressed" and d["reason"] == \
+            "window_exhausted"
+        assert rel_get("lifecycle.autopilot.suppressed.window_exhausted") == 1
+        assert server.replicas.versions() == {"default": 2}   # no 2nd refit
+
+        rep = server.report()
+        assert validate_report(rep) == []
+        sec = rep["autopilot"]
+        assert sec["promoted"] == 1 and sec["suppressed"] == 1
+        assert sec["budget"]["refits_in_window"] == 1
+        assert sec["budget"]["suppressed"] == {"window_exhausted": 1}
+        kinds = [e["decision"] for e in sec["decisions"]]
+        assert kinds == ["triggered", "promoted", "suppressed"]
+    finally:
+        server.stop()
+
+
+# -- empty window / rejected candidate fail safe -----------------------------
+
+def test_empty_window_is_candidate_rejected_not_crash(rng):
+    X, y = _data(rng)
+    server = _fleet(_train(X, y))
+    try:
+        ap = _autopilot(server, _ctl(server), X, y)
+        # no baseline, no traffic: a tick is a clean no-op
+        assert ap.tick() is None
+        # a refit cycle over an empty window is a typed rejection the
+        # tick loop records, never an unhandled crash
+        with pytest.raises(CandidateRejected) as ei:
+            ap._refit_cycle()
+        assert ei.value.report["reasons"] == ["empty_window"]
+        assert server.replicas.versions() == {"default": 1}
+    finally:
+        server.stop()
+
+
+def test_shadow_rejection_recorded_and_budget_released(rng):
+    X, y = _data(rng)
+    server = _fleet(_train(X, y))
+    try:
+        # impossible divergence gate: every candidate is shadow-rejected
+        ap = _autopilot(server, _ctl(server, divergence_max=1e-9), X, y,
+                        consecutive_checks=1)
+        _traffic(server, X)
+        assert server.capture_drift_baseline()
+        _traffic(server, _drifted(X))
+
+        d = ap.tick()
+        assert d["decision"] == "rejected" and d["reason"]
+        assert rel_get("lifecycle.autopilot.rejected") == 1
+        assert server.replicas.versions() == {"default": 1}
+        # the budget lock is released and the cycle still consumed its
+        # admission (a thrashing candidate cannot bypass the caps)
+        bud = ap.budget.section()
+        assert bud["active"] is False and bud["admitted"] == 1
+        assert validate_report(server.report()) == []
+    finally:
+        server.stop()
+
+
+# -- kill-mid-refit: resume is bit-identical, fleet never sees the partial ---
+
+def test_kill_mid_refit_resumes_bit_identical(rng, tmp_path):
+    """``train.crash`` kills the autopilot's refit mid-run: the fleet
+    keeps serving the incumbent (no partial candidate anywhere), the
+    budget lock is released, and the NEXT cycle resumes from the crash
+    snapshot to promote the bit-identical model an uninterrupted refit
+    would have produced."""
+    X, y = _data(rng)
+    inc = _train(X, y, 4)
+    server = _fleet(inc)
+    out = str(tmp_path / "ap_refit.txt")
+    try:
+        ctl = _ctl(server)
+        X2, y2 = _data(rng)
+        # label_fn=None keeps the refit training set fixed at (X2, y2)
+        # across cycles so bit-identical resume is well-defined even
+        # though the recorder window keeps moving between ticks
+        ap = Autopilot(server, ctl, lambda: (X2, y2), label_fn=None,
+                       consecutive_checks=1, budget=_budget(),
+                       num_boost_round=4, params=dict(_P),
+                       output_model=out, snapshot_freq=1)
+
+        # reference: the uninterrupted refit off the same incumbent
+        ref = ctl.refit(lgb.Dataset(X2, label=y2, params=dict(_P)),
+                        num_boost_round=4, params=dict(_P),
+                        output_model=out, snapshot_freq=1)
+        full_text = ref.model_to_string()
+        for f in glob.glob(out + ".snapshot_iter_*"):
+            os.unlink(f)
+
+        _traffic(server, X)
+        assert server.capture_drift_baseline()
+        _traffic(server, _drifted(X))
+
+        faults.arm("train.crash:nth=2")
+        d = ap.tick()
+        assert d["decision"] == "error" and "train.crash" in d["reason"]
+        faults.disarm()
+        assert rel_get("fault.train.crash") == 1
+        # the fleet never saw the partial candidate
+        assert server.replicas.versions() == {"default": 1}
+        assert all(s["models"] == {"default": 1}
+                   for s in server.replicas.section())
+        assert ap.budget.section()["active"] is False
+        assert list_snapshots(out), "the killed refit left snapshots"
+
+        # fresh drifted traffic arms the next cycle; resume picks up the
+        # crash snapshot and lands exactly where the full run would have
+        _traffic(server, _drifted(X))
+        d = ap.tick()
+        assert d["decision"] == "promoted", d
+        assert rel_get("resume_runs") == 1
+        assert server.replicas.versions() == {"default": 2}
+        promoted = server.registry.get("default").booster
+        assert promoted.model_to_string() == full_text
+
+        rep = server.report()
+        assert validate_report(rep) == []
+        assert rep["autopilot"]["errors"] == 1
+        assert rep["autopilot"]["promoted"] == 1
+    finally:
+        server.stop()

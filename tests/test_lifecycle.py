@@ -473,3 +473,56 @@ def test_healthy_promotion_watchdog_clears(rng):
         assert rel_get("lifecycle.auto_rollbacks") == 0
     finally:
         server.stop()
+
+
+def test_back_to_back_promotions_cancel_stale_watchdog(rng):
+    """Regression: two rapid ``run_cycle`` calls must not leave the
+    FIRST promotion's watchdog running against its stale baseline —
+    errors injected between the promotions would otherwise count
+    against promotion #2's health gates and roll it back spuriously.
+    ``promote`` now cancels + joins the stale watchdog and the new one
+    re-baselines off the CURRENT counters."""
+    X, y = _data(rng)
+    server = _serve(_train(X, y, 4))
+    try:
+        # a long watch interval: the stale watchdog would sit armed for
+        # the whole drill unless promote() explicitly cancels it
+        ctl = LifecycleController(server, divergence_max=0.75,
+                                  rollback_deadline_s=30.0,
+                                  watch_interval_s=10.0,
+                                  error_rate_max=0.05)
+        _traffic(server, X)
+        X2, y2 = _data(rng)
+        p = dict(_P)
+        ctl.run_cycle(lgb.Dataset(X2, label=y2, params=dict(p)), 2, p,
+                      watch=True)
+        w1 = ctl.watchdog
+        assert w1 is not None and w1.result is None
+
+        # fallbacks between the promotions — exactly the counters whose
+        # deltas a stale baseline would blame on promotion #2
+        faults.arm("serve.predict.fail:count=2")
+        with ServingClient(server.host, server.port) as c:
+            for _ in range(4):
+                c.predict(X[:8])
+        faults.disarm()
+        assert server.stats.fallback_batches >= 2
+
+        X3, y3 = _data(rng)
+        ctl.run_cycle(lgb.Dataset(X3, label=y3, params=dict(p)), 2, p,
+                      watch=True)
+        w2 = ctl.watchdog
+        assert w2 is not w1
+        # the stale watchdog is truly gone, not lingering mid-interval
+        assert w1.join(timeout=10) and w1.result == "cancelled"
+        # the new one re-baselined AFTER the injected fallbacks
+        assert w2._base["fallback_batches"] == server.stats.fallback_batches
+        assert w2.result is None
+        # nothing rolled back: version 3 serves
+        assert server.registry.get("default").version == 3
+        assert rel_get("lifecycle.auto_rollbacks") == 0
+        ctl.stop()
+        assert w2.result == "cancelled"
+    finally:
+        faults.disarm()
+        server.stop()

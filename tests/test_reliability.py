@@ -421,6 +421,6 @@ def test_reliability_section_in_training_report(rng):
     bst = lgb.train(dict(p), lgb.Dataset(X, label=y, params=dict(p)), 3,
                     verbose_eval=False)
     rep = bst.get_telemetry()
-    assert rep["schema_version"] == 9   # v9: optional elastic section
+    assert rep["schema_version"] == 10  # v10: optional autopilot section
     assert "counters" in rep["reliability"]
     assert validate_report(rep) == []

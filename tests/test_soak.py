@@ -1,0 +1,329 @@
+"""Autopilot soak drill — the self-driving-fleet acceptance artifact.
+
+A 2-replica fleet serves mixed-protocol traffic at ``retries=0`` while
+the :class:`~lightgbm_tpu.lifecycle.Autopilot` daemon runs unattended
+and the drill injects faults through the ``LGBT_FAULTS`` environment
+variable (the exact production knob, not test-only plumbing):
+
+* ``serving.replica_fault`` — a replica's device path fails under load,
+* ``serve.predict.delay`` — device stalls create shed pressure against
+  the per-tenant admission cap,
+* ``train.crash`` — the FIRST autopilot refit is killed mid-training
+  and must resume from its crash snapshot on the next budgeted cycle.
+
+The traffic distribution is flipped (feature 0 shifted +6σ) to force a
+sustained drifted window.  The drill then asserts the full contract
+off the schema-v10 report: at least one autopilot promotion landed
+fleet-wide, ZERO requests were dropped (sheds are answered, not
+dropped), every served score matched a legitimately-promoted model
+(no partial or regressed candidate was ever visible), the refit budget
+caps were honored with suppressions on the record, and the report
+validates against the published schema.
+
+The short leg runs in the tier-1 suite; the ``slow`` leg extends the
+horizon across a second distribution flip and demands two promotions.
+Timings are CPU-relative (see PROFILE.md).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.lifecycle import (Autopilot, LifecycleController,
+                                    RefitBudget)
+from lightgbm_tpu.observability import validate_report
+from lightgbm_tpu.observability.telemetry import SCHEMA_VERSION
+from lightgbm_tpu.reliability import faults, rel_get, rel_reset
+from lightgbm_tpu.serving import ServerOverloaded, ServingClient
+
+pytestmark = pytest.mark.soak
+
+
+@pytest.fixture(autouse=True)
+def _pristine_faults():
+    faults.reset()
+    rel_reset()
+    yield
+    faults.reset()
+    rel_reset()
+
+
+_P = {"objective": "binary", "num_leaves": 7, "min_data_in_leaf": 10,
+      "verbosity": -1}
+
+_FAULT_SPEC = ("serving.replica_fault:rank=1:count=2;"
+               "serve.predict.delay:seconds=0.08:nth=30:count=6;"
+               "train.crash:nth=2:count=1")
+
+
+def _data(rng, n=600):
+    X = rng.randn(n, 4)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(float)
+    return X, y
+
+
+def _label(X):
+    X = np.asarray(X)
+    return (X[:, 0] + 0.5 * X[:, 1] > 0).astype(float)
+
+
+def _train(X, y, rounds=5):
+    return lgb.train(dict(_P), lgb.Dataset(X, label=y, params=dict(_P)),
+                     rounds, verbose_eval=False)
+
+
+class _Drill:
+    """Shared soak harness: fleet + hammers + parity probe + autopilot."""
+
+    def __init__(self, rng, tmp_path, *, budget_max, min_spacing_s,
+                 interval_s=0.4):
+        self.X, self.y = _data(rng)
+        self.incumbent = _train(self.X, self.y)
+        # tenant cap (3) below the global cap (4) with 5 concurrent
+        # clients: the delay fault makes requests pile up, so overload
+        # answers come from the per-tenant admission path
+        self.server = self.incumbent.serve(
+            replicas=2, port=0, max_batch_rows=64, min_bucket=32,
+            record_rows=96, drift_min_rows=32, deadline_ms=1.0,
+            max_inflight=4, tenant_max_inflight=3)
+        self.stop = threading.Event()
+        self.drift_on = threading.Event()
+        self.failures = []
+        self.parity_failures = []
+        self.sheds = [0]
+        self.counts = [0] * 4
+        self._shed_lock = threading.Lock()
+        ctl = LifecycleController(self.server, divergence_max=10.0,
+                                  latency_max_ratio=100.0,
+                                  min_shadow_rows=16)
+        self.budget = RefitBudget(max_refits_per_window=budget_max,
+                                  window_s=300.0,
+                                  min_spacing_s=min_spacing_s,
+                                  cooldown_s=2.0)
+        self.autopilot = Autopilot(
+            self.server, ctl, lambda: (self.X, self.y), label_fn=_label,
+            consecutive_checks=2, budget=self.budget, num_boost_round=3,
+            params=dict(_P), output_model=str(tmp_path / "soak_refit.txt"),
+            snapshot_freq=1, settle_s=0.01, interval_s=interval_s)
+        self.threads = []
+
+    # -- traffic -------------------------------------------------------
+
+    def _rows(self, rng_w, n):
+        Xr = rng_w.randn(n, 4)
+        if self.drift_on.is_set():
+            Xr[:, 0] += 6.0
+        return Xr
+
+    def _hammer(self, wid):
+        rng_w = np.random.RandomState(500 + wid)
+        proto = "binary" if wid % 2 else "pickle"
+        try:
+            with ServingClient(self.server.host, self.server.port,
+                               timeout=60, protocol=proto, retries=0) as c:
+                while not self.stop.is_set():
+                    Xr = self._rows(rng_w, 24)
+                    try:
+                        s = np.asarray(c.predict(Xr)).ravel()
+                    except ServerOverloaded:
+                        with self._shed_lock:   # shed is an answer,
+                            self.sheds[0] += 1  # never a drop
+                        time.sleep(0.002)       # back off, then retry
+                        continue
+                    assert s.shape == (24,) and np.all(np.isfinite(s))
+                    self.counts[wid] += 1
+                    time.sleep(0.002)
+        except BaseException as e:              # noqa: BLE001 — the drill
+            self.failures.append((wid, repr(e)))
+
+    def _parity(self):
+        """Every served answer must match SOME legitimately-promoted
+        model (current or mid-roll neighbour version) — a partial or
+        corrupt candidate can never reach a client."""
+        rng_p = np.random.RandomState(999)
+        try:
+            with ServingClient(self.server.host, self.server.port,
+                               timeout=60, retries=0) as c:
+                while not self.stop.is_set():
+                    Xp = self._rows(rng_p, 16)
+                    models = {m.version: m for m in self._registries()}
+                    try:
+                        s = np.asarray(c.predict(Xp,
+                                                 raw_score=True)).ravel()
+                    except ServerOverloaded:
+                        continue
+                    models.update({m.version: m
+                                   for m in self._registries()})
+                    ok = any(np.allclose(
+                        s, m.booster.predict(Xp, raw_score=True).ravel(),
+                        rtol=1e-5, atol=1e-6) for m in models.values())
+                    if not ok:
+                        self.parity_failures.append(
+                            (sorted(models), s[:4].tolist()))
+                    time.sleep(0.05)
+        except BaseException as e:              # noqa: BLE001
+            self.failures.append(("parity", repr(e)))
+
+    def _registries(self):
+        out = []
+        for r in self.server.replicas.replicas:
+            try:
+                out.append(r.registry.get("default"))
+            except KeyError:
+                pass
+        return out
+
+    # -- drill body ----------------------------------------------------
+
+    def start(self):
+        self.threads = [threading.Thread(target=self._hammer, args=(i,),
+                                         daemon=True) for i in range(4)]
+        self.threads.append(threading.Thread(target=self._parity,
+                                             daemon=True))
+        for t in self.threads:
+            t.start()
+        # warm clean traffic fills the recorder → promote-time baseline
+        deadline = time.monotonic() + 15
+        while (len(self.server.recorder) < 32
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+        assert self.server.capture_drift_baseline()
+        self.autopilot.start()
+
+    def wait(self, cond, timeout_s):
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if cond(self.autopilot.section()):
+                return True
+            time.sleep(0.2)
+        return False
+
+    def finish(self):
+        if getattr(self, "_finished", False):
+            return
+        self._finished = True
+        self.autopilot.stop()
+        self.stop.set()
+        for t in self.threads:
+            t.join(30)
+        self.server.stop()
+
+
+def _assert_contract(drill, *, budget_max):
+    """The soak guarantees common to both legs."""
+    assert drill.failures == [], drill.failures
+    assert drill.parity_failures == [], drill.parity_failures
+    assert min(drill.counts) > 0, drill.counts
+
+    rep = drill.server.report()
+    assert rep["schema_version"] == SCHEMA_VERSION == 10
+    assert validate_report(rep) == []
+
+    sec = rep["autopilot"]
+    kinds = [d["decision"] for d in sec["decisions"]]
+    assert sec["promoted"] >= 1 and "promoted" in kinds
+    assert sec["errors"] >= 1 and "error" in kinds    # kill-mid-refit
+    assert sec["suppressed"] >= 1 and "suppressed" in kinds
+    reasons = {d.get("reason") for d in sec["decisions"]
+               if d["decision"] == "suppressed"}
+    assert reasons & {"min_spacing", "window_exhausted", "cooldown",
+                      "concurrent_refit"}, reasons
+    # budget caps held: every admission is on the books and bounded
+    assert sec["triggered"] <= budget_max
+    bud = sec["budget"]
+    assert bud["admitted"] == sec["triggered"] <= budget_max
+    assert bud["refits_in_window"] <= budget_max
+
+    # the promotion landed fleet-wide, never partially
+    versions = {s["models"]["default"]
+                for s in drill.server.replicas.section()}
+    assert len(versions) == 1 and versions.pop() >= 2
+
+    # every injected fault actually fired through LGBT_FAULTS
+    assert rel_get("fault.train.crash") == 1
+    assert rel_get("fault.serving.replica_fault") >= 1
+    assert rel_get("fault.serve.predict.delay") >= 1
+    assert rel_get("resume_runs") >= 1                # snapshot resume
+
+    # shed pressure was real and fully accounted: overloads were
+    # answered (zero drops above) and the per-tenant path shows up in
+    # the tenant section the error budget reads
+    assert drill.sheds[0] >= 1
+    assert drill.server.stats.shed >= drill.sheds[0]
+    tenants = {t["model"]: t for t in rep["serving"]["tenants"]}
+    assert tenants["default"]["tenant_shed"] >= 1
+    return sec
+
+
+@pytest.mark.soak(timeout=300)
+def test_soak_autopilot_short(rng, tmp_path, monkeypatch):
+    """Tier-1 leg: one full autopilot arc — drift detected, first refit
+    killed mid-run, the resumed refit shadow-gated and rolled
+    replica-by-replica, then the budget window cap provably suppresses
+    the refit the next distribution flip would have triggered."""
+    drill = _Drill(rng, tmp_path, budget_max=2, min_spacing_s=6.0)
+    try:
+        # arm AFTER the incumbent trained and the fleet warmed up: the
+        # faults belong to the drill's traffic, not the seed model
+        monkeypatch.setenv(faults.ENV_VAR, _FAULT_SPEC)
+        faults.reset()                 # re-read the env on next fire
+        drill.start()
+        drill.drift_on.set()
+        # arc 1: first refit crashes mid-run (error), the next budgeted
+        # cycle resumes from the snapshot and promotes fleet-wide
+        assert drill.wait(lambda s: s["promoted"] >= 1
+                          and s["errors"] >= 1, 200), \
+            drill.autopilot.section()
+        # arc 2: flip back — the recaptured baseline reads the original
+        # distribution as sustained drift, but both budgeted admissions
+        # are spent: the window cap must suppress, on the record
+        drill.drift_on.clear()
+        assert drill.wait(lambda s: any(
+            d["decision"] == "suppressed"
+            and d.get("reason") == "window_exhausted"
+            for d in s["decisions"]), 60), drill.autopilot.section()
+        drill.finish()
+        _assert_contract(drill, budget_max=2)
+    finally:
+        drill.finish()
+
+
+@pytest.mark.slow
+@pytest.mark.soak(timeout=560)
+def test_soak_autopilot_long(rng, tmp_path, monkeypatch):
+    """Slow leg: two full drift→refit→promote arcs (the second resumes
+    nothing — it must be a clean budgeted cycle) across a distribution
+    flip, same zero-drop / parity / budget contract."""
+    drill = _Drill(rng, tmp_path, budget_max=3, min_spacing_s=6.0)
+    try:
+        monkeypatch.setenv(faults.ENV_VAR, _FAULT_SPEC)
+        faults.reset()
+        drill.start()
+        drill.drift_on.set()
+        assert drill.wait(lambda s: s["promoted"] >= 1
+                          and s["errors"] >= 1, 200), \
+            drill.autopilot.section()
+        # flip the distribution: the promote-time baseline now reads
+        # the ORIGINAL traffic as drifted → a second autopilot arc
+        # (clean this time — the crash budget is spent)
+        drill.drift_on.clear()
+        assert drill.wait(lambda s: s["promoted"] >= 2, 200), \
+            drill.autopilot.section()
+        # third flip: sustained drift again, but all three budgeted
+        # admissions are gone — the window cap suppresses
+        drill.drift_on.set()
+        assert drill.wait(lambda s: any(
+            d["decision"] == "suppressed"
+            and d.get("reason") == "window_exhausted"
+            for d in s["decisions"]), 60), drill.autopilot.section()
+        drill.finish()
+        sec = _assert_contract(drill, budget_max=3)
+        assert sec["promoted"] >= 2
+        versions = {s["models"]["default"]
+                    for s in drill.server.replicas.section()}
+        assert versions == {1 + sec["promoted"]}
+    finally:
+        drill.finish()
