@@ -234,6 +234,10 @@ def main():
         for rep in reports.values():
             assert "provenance" in rep, \
                 "telemetry report lost its provenance block (schema v7)"
+            # schema v11: the perf artifact must name the exact cost
+            # ledger (analysis/costs.json sha256) it was gated against
+            assert "cost_ledger_sha256" in rep["provenance"], \
+                "telemetry provenance lost cost_ledger_sha256 (schema v11)"
             errs = validate_report(rep)
             assert not errs, errs
         with open(telemetry_out, "w") as fh:

@@ -280,7 +280,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     from lightgbm_tpu.observability import provenance_section
 
     report = {
-        "schema_version": 1,
+        # v2: provenance carries cost_ledger_sha256 (analysis/costs.json)
+        "schema_version": 2,
         "round": args.round,
         # the driver's TPU runs are the arbiter; CPU seeds are marked
         "platform": jax.devices()[0].platform,
@@ -313,6 +314,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     assert "provenance" in report and \
         isinstance(report["provenance"].get("emulated"), bool), \
         "BENCH_SERVING report lost its provenance block"
+    assert "cost_ledger_sha256" in report["provenance"], \
+        "BENCH_SERVING provenance lost cost_ledger_sha256 (schema v2)"
     errs = validate_report(report, BENCH_SERVING_SCHEMA)
     if errs:
         print(f"BENCH_SERVING report violates schema: {errs}",

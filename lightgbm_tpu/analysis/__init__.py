@@ -7,7 +7,7 @@ fixed by hand (K collectives per stall event, corrupt length prefixes
 driving multi-GB allocs, recompiles on every new row count) at ANALYSIS
 time instead of in chaos tests or on-device profiles.
 
-Six passes, one gate:
+Eight passes, one gate:
 
   * ``jaxpr_lint``  — trace the wave tree step, the sharded learners and
     the serving binner/traversal programs; walk the closed jaxprs and
@@ -36,6 +36,24 @@ Six passes, one gate:
   * ``lint``        — repo-specific AST rules (socket timeouts, atomic
     writes, seeded RNGs, no bare except, no wall clocks in traced code)
     with a checked-in allowlist for vetted exceptions.
+  * ``costmodel``   — the static cost-model ledger: per traced program,
+    XLA's analytical FLOPs and bytes-accessed, a jaxpr-liveness
+    peak-live-bytes estimate and per-primitive collective exchange
+    payloads, pinned in ``costs.json`` with per-metric tolerance bands
+    and re-derivable byte-identically via ``--dump-costs``.  A 2x FLOP
+    regression or a doubled psum payload fails the gate on a CPU-only
+    box — no TPU profile needed to catch it.
+  * ``resources``   — resource-lifecycle pass over the host-side modules
+    (serving/, lifecycle/, elastic/, io/, observability/): every
+    started thread joined on the teardown path (LGB011), every
+    socket/selector/file closed on all paths including error paths
+    (LGB012), every subprocess reaped — ``wait``/``communicate`` or a
+    kill-and-reap arm, and no unbounded ``subprocess.run`` (LGB013).
+    Proves clean shutdown without hardware, the same
+    allowlist-with-reason workflow as ``lint``.
+
+The gate also always runs an allowlist-staleness check: every vetted
+exception must still resolve to an existing file and symbol.
 
 Gate: ``python -m lightgbm_tpu.analysis --json report.json`` exits
 non-zero on any finding; the report validates against
@@ -47,9 +65,11 @@ run anywhere.
 """
 
 from .common import (Finding, apply_allowlist, build_report, is_allowed,
-                     load_allowlist, load_budgets, load_schema,
-                     load_sequences, validate_findings_report)
+                     load_allowlist, load_budgets, load_costs, load_schema,
+                     load_sequences, stale_allowlist_findings,
+                     validate_findings_report)
 
 __all__ = ["Finding", "apply_allowlist", "build_report", "is_allowed",
-           "load_allowlist", "load_budgets", "load_schema",
-           "load_sequences", "validate_findings_report"]
+           "load_allowlist", "load_budgets", "load_costs", "load_schema",
+           "load_sequences", "stale_allowlist_findings",
+           "validate_findings_report"]

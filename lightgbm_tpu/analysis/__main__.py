@@ -1,20 +1,26 @@
 """The analysis gate: ``python -m lightgbm_tpu.analysis [--json out.json]``.
 
-Runs the six passes (lint, races, spmd, donation, jaxpr, recompile),
-prints a summary, optionally writes the schema-validated JSON findings
-report, and exits non-zero when any unsuppressed finding remains — so it
-can run as a pre-merge check.
+Runs the eight passes (lint, races, resources, spmd, donation, jaxpr,
+costmodel, recompile) plus the always-on allowlist-staleness check,
+prints a summary with per-pass wall time, optionally writes the
+schema-validated JSON findings report, and exits non-zero when any
+unsuppressed finding remains — so it can run as a pre-merge check.
 
 The traced-program passes share ONE trace cache: each budgeted program
-is traced exactly once per gate run and consumed by both the jaxpr
-budget lints and the spmd collective-order checks; per-program trace
-seconds land in the JSON report.  ``--programs <glob>`` narrows the
-traced set for scoped CI/local runs (AST passes always run in full).
+is traced exactly once per gate run and consumed by the jaxpr budget
+lints, the spmd collective-order checks and the cost-model ledger;
+per-program trace seconds land in the JSON report.  ``--programs
+<glob>`` narrows the traced set for scoped CI/local runs (AST passes
+always run in full); ``--changed-only REF`` scopes BOTH the AST file
+sets and the traced-program set to files ``git diff --name-only REF``
+reports (the recompile sentinel still runs — cache-identity bugs do not
+localize to a diff).
 
-``--dump-budgets`` re-derives ``budgets.json`` and ``--dump-sequences``
-re-derives ``sequences.json`` from the currently traced programs (run
-them when a reviewed learner change legitimately moves a collective
-count or reorders the schedule, and commit the diff).
+``--dump-budgets`` re-derives ``budgets.json``, ``--dump-sequences``
+re-derives ``sequences.json`` and ``--dump-costs`` re-derives
+``costs.json`` from the currently traced programs (run them when a
+reviewed learner change legitimately moves a collective count, reorders
+the schedule or shifts a pinned cost, and commit the diff).
 """
 
 from __future__ import annotations
@@ -23,16 +29,21 @@ import argparse
 import json
 import os
 import sys
-from typing import Dict, List
+import time
+from typing import Dict, List, Optional, Sequence
 
-from . import donation, jaxpr_lint, lint, races, recompile, spmd
-from .common import (BUDGETS_PATH, SEQUENCES_PATH, Finding, build_report,
-                     validate_findings_report)
+from . import (costmodel, donation, jaxpr_lint, lint, races, recompile,
+               resources, spmd)
+from .common import (BUDGETS_PATH, COSTS_PATH, REPO_ROOT, SEQUENCES_PATH,
+                     Finding, build_report, rel_file,
+                     stale_allowlist_findings, validate_findings_report)
 
-ALL_PASSES = ("lint", "races", "spmd", "donation", "jaxpr", "recompile")
+ALL_PASSES = ("lint", "races", "resources", "spmd", "donation", "jaxpr",
+              "costmodel", "recompile")
 
 #: passes that need a live jax backend (the rest are pure-AST)
-_JAX_PASSES = frozenset({"spmd", "donation", "jaxpr", "recompile"})
+_JAX_PASSES = frozenset({"spmd", "donation", "jaxpr", "costmodel",
+                         "recompile"})
 
 
 def _ensure_cpu_platform() -> None:
@@ -58,6 +69,33 @@ def _environment() -> Dict[str, object]:
             "jax_version": jax.__version__}
 
 
+def _changed_files(ref: str) -> Optional[set]:
+    """Repo-relative paths touched since ``ref`` (tracked diffs plus
+    untracked files), or None when git cannot answer."""
+    import subprocess
+    try:
+        diff = subprocess.run(
+            ["git", "diff", "--name-only", ref, "--"], cwd=REPO_ROOT,
+            capture_output=True, text=True, timeout=30.0, check=True).stdout
+        untracked = subprocess.run(
+            ["git", "ls-files", "--others", "--exclude-standard"],
+            cwd=REPO_ROOT, capture_output=True, text=True, timeout=30.0,
+            check=True).stdout
+    except (OSError, subprocess.SubprocessError):
+        return None
+    return {ln.strip() for ln in (diff + untracked).splitlines()
+            if ln.strip()}
+
+
+def _walk_py(root: str) -> List[str]:
+    out: List[str] = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
+        out.extend(os.path.join(dirpath, f) for f in sorted(filenames)
+                   if f.endswith(".py"))
+    return out
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m lightgbm_tpu.analysis",
@@ -67,12 +105,19 @@ def main(argv=None) -> int:
                          "(convention: reports/analysis_report.json, next "
                          "to the observability report artifacts)")
     ap.add_argument("--passes", default=",".join(ALL_PASSES),
-                    help="comma list from "
-                         "{lint,races,spmd,donation,jaxpr,recompile}")
+                    help="comma list from {" + ",".join(ALL_PASSES) + "}")
     ap.add_argument("--programs", metavar="GLOB", default="",
                     help="fnmatch glob narrowing the traced-program set "
                          "(jaxpr budgets + spmd sequences + donation HLO "
-                         "asserts) for scoped runs, e.g. 'wave_sharded*'")
+                         "asserts + cost ledger) for scoped runs, e.g. "
+                         "'wave_sharded*'")
+    ap.add_argument("--changed-only", metavar="REF", default="",
+                    help="scope the AST passes and the traced-program set "
+                         "to files changed since REF (git diff + "
+                         "untracked); the recompile sentinel and the "
+                         "allowlist-staleness check still run in full. "
+                         "Falls back to the full gate when the analyzer "
+                         "itself changed or git fails.")
     ap.add_argument("--dump-budgets", metavar="PATH", nargs="?",
                     const=BUDGETS_PATH, default="",
                     help="trace the program set and (re)write budgets.json "
@@ -81,6 +126,10 @@ def main(argv=None) -> int:
                     const=SEQUENCES_PATH, default="",
                     help="trace the program set and (re)write "
                          "sequences.json instead of gating")
+    ap.add_argument("--dump-costs", metavar="PATH", nargs="?",
+                    const=COSTS_PATH, default="",
+                    help="trace the program set and (re)write costs.json "
+                         "(the static cost-model ledger) instead of gating")
     ap.add_argument("--quiet", action="store_true")
     args = ap.parse_args(argv)
 
@@ -93,7 +142,7 @@ def main(argv=None) -> int:
         if not args.quiet:
             print(f"[lightgbm_tpu.analysis] {msg}", flush=True)
 
-    dumping = args.dump_budgets or args.dump_sequences
+    dumping = args.dump_budgets or args.dump_sequences or args.dump_costs
     if dumping or (_JAX_PASSES & set(selected)):
         _ensure_cpu_platform()
 
@@ -121,24 +170,85 @@ def main(argv=None) -> int:
             for name, closed in sorted(traced.closed.items()):
                 seq = spmd.extract_sequence(closed)
                 log(f"  {name}: {len(seq)} collective(s) in order")
+        if args.dump_costs:
+            payload = costmodel.dump_costs(traced, args.dump_costs)
+            log(f"wrote {args.dump_costs}")
+            for name, entry in sorted(payload["programs"].items()):
+                ex = sum(entry["exchange_bytes"].values())
+                log(f"  {name}: flops={entry['flops']} "
+                    f"bytes={entry['bytes_accessed']} "
+                    f"peak={entry['peak_live_bytes']} exchange={ex}")
         return 0
+
+    # --changed-only REF: scope the AST file sets and the traced set to
+    # the diff.  A change under analysis/ (the analyzer, its pins, the
+    # allowlist) invalidates every scoping assumption — run in full.
+    changed: Optional[set] = None
+    if args.changed_only:
+        changed = _changed_files(args.changed_only)
+        if changed is None:
+            log(f"WARNING: git diff against {args.changed_only!r} failed "
+                "— running the full gate")
+        elif any(p.startswith("lightgbm_tpu/analysis/") for p in changed):
+            log("--changed-only: analysis/ itself changed — running the "
+                "full gate")
+            changed = None
+        else:
+            log(f"--changed-only {args.changed_only}: "
+                f"{len(changed)} changed file(s)")
+
+    def scoped(default_paths: Sequence[str]) -> Optional[List[str]]:
+        """None = pass default (full scan); a list = the changed subset."""
+        if changed is None:
+            return None
+        return [p for p in default_paths if rel_file(p) in changed]
 
     findings: List[Finding] = []
     pass_results: Dict[str, Dict[str, object]] = {}
+    pass_seconds: Dict[str, float] = {}
     n = len(selected)
     step = iter(range(1, n + 1))
 
-    # one trace per program, shared by the spmd order checks and the
-    # jaxpr budget lints (satellite: the gate must not re-trace)
+    def finish(name: str, t0: float, kept: Sequence[Finding],
+               result: Dict[str, object]) -> None:
+        secs = round(time.perf_counter() - t0, 3)
+        result["seconds"] = secs
+        pass_seconds[name] = secs
+        findings.extend(kept)
+        pass_results[name] = result
+        log(f"  {name}: {len(kept)} finding(s) in {secs:.2f}s")
+
+    # the allowlist-staleness check always runs: a rotted vetted
+    # exception (file moved, symbol renamed) silently suppresses the
+    # wrong thing, so no pass selection may skip it
+    t0 = time.perf_counter()
+    stale = stale_allowlist_findings()
+    finish("allowlist", t0, stale,
+           {"status": "findings" if stale else "ok",
+            "findings": len(stale)})
+
+    # one trace per program, shared by the spmd order checks, the jaxpr
+    # budget lints and the cost-model ledger (the gate must not re-trace)
     traced = None
-    if "spmd" in selected or "jaxpr" in selected:
-        log("tracing the program set once (shared by spmd + jaxpr) ...")
-        traced = jaxpr_lint.trace_programs(glob=args.programs or None)
+    if {"spmd", "jaxpr", "costmodel"} & set(selected):
+        only = None
+        if changed is not None:
+            only = {name for name, f in jaxpr_lint.PROGRAM_FILES.items()
+                    if f in changed}
+        log("tracing the program set once (shared by spmd + jaxpr + "
+            "costmodel) ...")
+        t0 = time.perf_counter()
+        traced = jaxpr_lint.trace_programs(glob=args.programs or None,
+                                           only=only)
+        log(f"  traced {len(traced.closed)} program(s) in "
+            f"{time.perf_counter() - t0:.2f}s")
 
     if "lint" in selected:
         log(f"pass {next(step)}/{n}: AST repo lint + report schema "
             "drift ...")
-        kept, suppressed = lint.run()
+        t0 = time.perf_counter()
+        kept, suppressed = lint.run(
+            paths=scoped(list(lint.iter_package_files())))
         # LGB006: the emitted telemetry/serving reports vs schema.json —
         # drift (a section key without a schema property, or a report the
         # validator rejects) gates the same way an AST finding does
@@ -146,54 +256,74 @@ def main(argv=None) -> int:
         drift_kept, drift_sup = apply_allowlist(lint.schema_drift(),
                                                 load_allowlist())
         kept = kept + drift_kept
-        findings.extend(kept)
-        pass_results["lint"] = {
+        finish("lint", t0, kept, {
             "status": "findings" if kept else "ok",
             "findings": len(kept),
-            "suppressed": len(suppressed) + len(drift_sup)}
+            "suppressed": len(suppressed) + len(drift_sup)})
 
     if "races" in selected:
         log(f"pass {next(step)}/{n}: lock-order race detector ...")
-        kept, suppressed = races.run()
-        findings.extend(kept)
-        pass_results["races"] = {
+        t0 = time.perf_counter()
+        kept, suppressed = races.run(paths=scoped(
+            [os.path.join(races.PKG_ROOT, p) for p in races.DEFAULT_FILES]))
+        finish("races", t0, kept, {
             "status": "findings" if kept else "ok",
-            "findings": len(kept), "suppressed": len(suppressed)}
+            "findings": len(kept), "suppressed": len(suppressed)})
+
+    if "resources" in selected:
+        log(f"pass {next(step)}/{n}: resource lifecycle — thread "
+            "join-on-stop (LGB011), close-on-all-paths (LGB012), "
+            "subprocess reaping (LGB013) ...")
+        t0 = time.perf_counter()
+        kept, suppressed = resources.run(
+            paths=scoped(list(resources.iter_scan_files())))
+        finish("resources", t0, kept, {
+            "status": "findings" if kept else "ok",
+            "findings": len(kept), "suppressed": len(suppressed)})
 
     if "spmd" in selected:
         log(f"pass {next(step)}/{n}: SPMD safety — rank-divergence "
             "(LGB008), event-loop blocking (LGB010), collective-order "
             "pins ...")
-        kept, suppressed = spmd.run(traced=traced)
-        findings.extend(kept)
-        pass_results["spmd"] = {
+        t0 = time.perf_counter()
+        rank_default = [p for d in spmd.RANK_DIRS
+                        for p in _walk_py(os.path.join(spmd.PKG_ROOT, d))]
+        loop_default = [os.path.join(spmd.PKG_ROOT, p)
+                        for p in spmd.LOOP_FILES]
+        kept, suppressed = spmd.run(rank_paths=scoped(rank_default),
+                                    loop_paths=scoped(loop_default),
+                                    traced=traced)
+        finish("spmd", t0, kept, {
             "status": "findings" if kept else "ok",
-            "findings": len(kept), "suppressed": len(suppressed)}
+            "findings": len(kept), "suppressed": len(suppressed)})
 
     if "donation" in selected:
         log(f"pass {next(step)}/{n}: use-after-donate (LGB009) + HLO "
             "donation-liveness asserts (this compiles the donating "
             "programs) ...")
+        t0 = time.perf_counter()
         import fnmatch
         hlo_names = [p for p in donation.DONATING_PROGRAMS
-                     if not args.programs
-                     or fnmatch.fnmatch(p, args.programs)]
+                     if (not args.programs
+                         or fnmatch.fnmatch(p, args.programs))
+                     and (changed is None
+                          or jaxpr_lint.PROGRAM_FILES.get(p) in changed)]
         kept, suppressed, hlo_status = donation.run(
             with_hlo=bool(hlo_names), hlo_programs=hlo_names)
-        findings.extend(kept)
-        pass_results["donation"] = {
+        finish("donation", t0, kept, {
             "status": "findings" if kept else "ok",
             "findings": len(kept), "suppressed": len(suppressed),
             "detail": "; ".join(f"{k}={v}" for k, v in
                                 sorted(hlo_status.items()))
-            or f"hlo asserts not selected by --programs {args.programs!r}"}
+            or "hlo asserts not selected by "
+               f"--programs/--changed-only"})
 
     if "jaxpr" in selected:
         log(f"pass {next(step)}/{n}: traced-program lints (no "
             "compilation) ...")
+        t0 = time.perf_counter()
         fs, stats, skipped = jaxpr_lint.run(traced=traced)
-        findings.extend(fs)
-        pass_results["jaxpr"] = {
+        finish("jaxpr", t0, fs, {
             "status": "findings" if fs else "ok",
             "findings": len(fs),
             "programs": {name: {"collectives": st["collectives"],
@@ -204,19 +334,38 @@ def main(argv=None) -> int:
                          for name, st in stats.items()},
             "detail": ("skipped: " + "; ".join(
                 f"{k} ({v})" for k, v in sorted(skipped.items()))
-                if skipped else "all programs traced")}
+                if skipped else "all programs traced")})
+
+    if "costmodel" in selected:
+        log(f"pass {next(step)}/{n}: static cost-model ledger — XLA "
+            "flops/bytes, liveness peak, exchange payloads vs costs.json "
+            "(no compilation) ...")
+        t0 = time.perf_counter()
+        fs, measured, skipped = costmodel.run(traced=traced)
+        finish("costmodel", t0, fs, {
+            "status": "findings" if fs else "ok",
+            "findings": len(fs),
+            "programs": {name: {
+                "flops": m["flops"],
+                "bytes_accessed": m["bytes_accessed"],
+                "peak_live_bytes": m["peak_live_bytes"],
+                "exchange_bytes": dict(m["exchange_bytes"]),
+                "eqns": m["eqns"]} for name, m in measured.items()},
+            "detail": ("skipped: " + "; ".join(
+                f"{k} ({v})" for k, v in sorted(skipped.items()))
+                if skipped else "all programs measured")})
 
     if "recompile" in selected:
         log(f"pass {next(step)}/{n}: recompile sentinel (compiles and "
             "runs a tiny train + serving warm path) ...")
+        t0 = time.perf_counter()
         fs, detail, skip_reason = recompile.run()
-        findings.extend(fs)
-        pass_results["recompile"] = {
+        finish("recompile", t0, fs, {
             "status": ("skipped" if skip_reason
                        else "findings" if fs else "ok"),
             "findings": len(fs),
             "programs": detail,
-            **({"detail": skip_reason} if skip_reason else {})}
+            **({"detail": skip_reason} if skip_reason else {})})
 
     report = build_report(pass_results, findings,
                           environment=_environment()
@@ -242,6 +391,9 @@ def main(argv=None) -> int:
     total = len(findings)
     statuses = ", ".join(f"{k}={v['status']}"
                          for k, v in pass_results.items())
+    timings = " ".join(f"{k}={pass_seconds[k]:.2f}s"
+                       for k in pass_seconds)
+    log(f"per-pass wall time: {timings}")
     log(f"{total} finding(s) [{statuses}]")
     return 1 if total else 0
 

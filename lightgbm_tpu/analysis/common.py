@@ -20,13 +20,14 @@ import os
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
-SCHEMA_VERSION = 2
+SCHEMA_VERSION = 3
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 SCHEMA_PATH = os.path.join(_HERE, "schema.json")
 ALLOWLIST_PATH = os.path.join(_HERE, "allowlist.json")
 BUDGETS_PATH = os.path.join(_HERE, "budgets.json")
 SEQUENCES_PATH = os.path.join(_HERE, "sequences.json")
+COSTS_PATH = os.path.join(_HERE, "costs.json")
 
 #: the package under analysis (lightgbm_tpu/) and the repo root above it
 PKG_ROOT = os.path.dirname(_HERE)
@@ -98,6 +99,92 @@ def load_sequences(path: Optional[str] = None) -> Dict[str, Any]:
     if not os.path.exists(p):
         return {"programs": {}}
     return _load_json(p)
+
+
+def load_costs(path: Optional[str] = None) -> Dict[str, Any]:
+    """The checked-in per-program cost ledger (``costs.json``,
+    re-derivable via ``--dump-costs``)."""
+    p = COSTS_PATH if path is None else path
+    if not os.path.exists(p):
+        return {"tolerance": {}, "programs": {}}
+    return _load_json(p)
+
+
+def _file_qualnames(path: str) -> set:
+    """Every dotted function/class qualname defined in ``path`` (for
+    stale-allowlist symbol resolution)."""
+    import ast
+    with open(path) as fh:
+        tree = ast.parse(fh.read(), filename=path)
+    quals: set = set()
+
+    def visit(node, stack):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                quals.add(".".join(stack + [child.name]))
+                visit(child, stack + [child.name])
+            else:
+                visit(child, stack)
+
+    visit(tree, [])
+    return quals
+
+
+def _resolve_allow_file(suffix: str) -> Optional[str]:
+    """The on-disk file an allowlist ``file`` suffix points at (findings
+    match on suffix, so the entry may be shorter than repo-relative)."""
+    direct = os.path.join(REPO_ROOT, suffix)
+    if os.path.isfile(direct):
+        return direct
+    for dirpath, dirnames, filenames in os.walk(PKG_ROOT):
+        dirnames[:] = [d for d in sorted(dirnames) if d != "__pycache__"]
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            p = os.path.join(dirpath, fn)
+            if rel_file(p).endswith(suffix):
+                return p
+    return None
+
+
+def stale_allowlist_findings(allowlist: Optional[Sequence[Dict[str, Any]]]
+                             = None) -> List[Finding]:
+    """Every allowlist entry must still resolve: the file must exist and
+    the named symbol must still be defined in it — otherwise the vetted
+    exception has rotted (the file moved, the function was renamed) and
+    is silently suppressing nothing, or worse, the wrong thing."""
+    if allowlist is None:
+        allowlist = load_allowlist()
+    findings: List[Finding] = []
+    for i, entry in enumerate(allowlist):
+        where = f"allowlist entry #{i} (rule {entry.get('rule')!r})"
+        suffix = entry.get("file", "")
+        if not suffix:
+            findings.append(Finding(
+                "allowlist", "stale-allowlist", "analysis/allowlist.json",
+                f"{where} names no file — every vetted exception must "
+                f"pin the file it excuses", symbol=entry.get("symbol")))
+            continue
+        path = _resolve_allow_file(suffix)
+        if path is None:
+            findings.append(Finding(
+                "allowlist", "stale-allowlist", "analysis/allowlist.json",
+                f"{where} points at {suffix!r}, which no longer exists — "
+                f"delete the entry or fix the path",
+                symbol=entry.get("symbol")))
+            continue
+        sym = entry.get("symbol")
+        if sym is None:
+            continue
+        quals = _file_qualnames(path)
+        if sym in quals or any(q.endswith("." + sym) for q in quals):
+            continue
+        findings.append(Finding(
+            "allowlist", "stale-allowlist", "analysis/allowlist.json",
+            f"{where} names symbol {sym!r}, not defined in {suffix!r} "
+            f"anymore — delete the entry or fix the symbol", symbol=sym))
+    return findings
 
 
 def is_allowed(finding: Finding, allowlist: Sequence[Dict[str, Any]]) -> bool:

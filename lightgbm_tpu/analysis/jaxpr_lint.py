@@ -440,15 +440,22 @@ class TracedPrograms:
 
 
 def trace_programs(programs: Optional[Dict[str, Callable[[], Any]]] = None,
-                   glob: Optional[str] = None) -> TracedPrograms:
+                   glob: Optional[str] = None,
+                   only: Optional[set] = None) -> TracedPrograms:
     """Trace the standard program set once (``--programs <glob>`` narrows
-    the selection) and return the shared :class:`TracedPrograms` cache."""
+    the selection) and return the shared :class:`TracedPrograms` cache.
+    ``only`` (a set of program names, or None for all) is the
+    ``--changed-only`` narrowing: programs outside it are skipped with a
+    reason that names the flag, so the report stays auditable."""
     if programs is None:
         programs = program_builders()
     tp = TracedPrograms()
     for name in sorted(PROGRAM_FILES):
         if glob and not fnmatch.fnmatch(name, glob):
             tp.skipped[name] = f"not selected by --programs {glob!r}"
+            continue
+        if only is not None and name not in only:
+            tp.skipped[name] = "source file unchanged under --changed-only"
             continue
         builder = programs.get(name)
         if builder is None:

@@ -140,15 +140,21 @@ def run_host(params: Dict[str, Any], data: str, num_boost_round: int,
                          args={"epoch": epoch.epoch,
                                "members": list(epoch.members)}):
             with open(log_path, "w") as log:
+                proc = subprocess.Popen(
+                    [sys.executable, "-m",
+                     "lightgbm_tpu.elastic.worker", spec_path],
+                    env=env, stdout=log, stderr=subprocess.STDOUT)
                 try:
-                    proc = subprocess.run(
-                        [sys.executable, "-m",
-                         "lightgbm_tpu.elastic.worker", spec_path],
-                        env=env, stdout=log, stderr=subprocess.STDOUT,
-                        timeout=float(worker_timeout_s))
-                    rc = proc.returncode
+                    rc = proc.wait(timeout=float(worker_timeout_s))
                 except subprocess.TimeoutExpired:
                     rc = None
+                finally:
+                    # reap-on-epoch-teardown: a timed-out (or any
+                    # still-running) worker is killed AND waited here, so
+                    # no epoch leaves a zombie behind for the next one
+                    if proc.poll() is None:
+                        proc.kill()
+                        proc.wait()
 
         def _tail(n: int = 2000) -> str:
             try:

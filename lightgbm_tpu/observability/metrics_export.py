@@ -384,7 +384,7 @@ BENCH_SERVING_SCHEMA: Dict[str, Any] = {
         "provenance": {
             "type": "object",
             "required": ["platform", "jax_version", "num_devices",
-                         "num_hosts", "emulated"],
+                         "num_hosts", "emulated", "cost_ledger_sha256"],
             "properties": {
                 "platform": {"type": "string"},
                 "device_kind": {"type": "string"},
@@ -394,6 +394,9 @@ BENCH_SERVING_SCHEMA: Dict[str, Any] = {
                 "process_index": {"type": "integer"},
                 "emulated": {"type": "boolean"},
                 "mesh_shape": {"type": ["string", "null"]},
+                # sha256 of the checked-in analysis/costs.json ledger the
+                # run was gated against (schema v2; null = ledger absent)
+                "cost_ledger_sha256": {"type": ["string", "null"]},
             },
         },
         "workload": {

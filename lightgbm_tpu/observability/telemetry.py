@@ -76,7 +76,23 @@ TEL_NAMES = {
 # the bounded decision history — `lightgbm_tpu/lifecycle/autopilot.py`);
 # serving.tenants[] items gain "tenant_shed" (sheds by the tenant's OWN
 # admission cap, `reliability/degrade.py` TenantAdmission)
-SCHEMA_VERSION = 10
+# v11: provenance gains "cost_ledger_sha256" — the sha256 of the checked-in
+# static cost-model ledger (`analysis/costs.json`) at report time, so any
+# perf artifact can be matched to the exact pinned FLOPs/bytes/exchange
+# expectations it was produced under (null when the ledger is absent)
+SCHEMA_VERSION = 11
+
+
+def _cost_ledger_sha256() -> Optional[str]:
+    """sha256 of ``analysis/costs.json`` (the static cost-model ledger),
+    or None when the ledger is not checked in."""
+    import hashlib
+    try:
+        from ..analysis.common import COSTS_PATH
+        with open(COSTS_PATH, "rb") as fh:
+            return hashlib.sha256(fh.read()).hexdigest()
+    except (OSError, ImportError):
+        return None
 
 
 def provenance_section(extra: Optional[Dict[str, Any]] = None
@@ -90,6 +106,7 @@ def provenance_section(extra: Optional[Dict[str, Any]] = None
         "platform": "unknown", "device_kind": "unknown",
         "jax_version": "unknown", "num_devices": 0, "num_hosts": 1,
         "process_index": 0, "emulated": True, "mesh_shape": None,
+        "cost_ledger_sha256": _cost_ledger_sha256(),
     }
     try:
         import jax

@@ -171,20 +171,27 @@ class FleetServer:
 
     def start(self) -> "FleetServer":
         srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        srv.bind((self.host, self.port))
-        srv.listen(128)
-        # the selector loop IS the timeout discipline: non-blocking
-        # sockets can never park a thread in recv/accept
-        srv.setblocking(False)
-        self.port = srv.getsockname()[1]
-        self._srv = srv
-        self._wake_r, self._wake_w = socket.socketpair()
-        self._wake_r.setblocking(False)
-        self._wake_w.setblocking(False)
-        self._sel = selectors.DefaultSelector()
-        self._sel.register(srv, selectors.EVENT_READ, "accept")
-        self._sel.register(self._wake_r, selectors.EVENT_READ, "wake")
+        try:
+            srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            srv.bind((self.host, self.port))
+            srv.listen(128)
+            # the selector loop IS the timeout discipline: non-blocking
+            # sockets can never park a thread in recv/accept
+            srv.setblocking(False)
+            self.port = srv.getsockname()[1]
+            self._srv = srv
+            self._wake_r, self._wake_w = socket.socketpair()
+            self._wake_r.setblocking(False)
+            self._wake_w.setblocking(False)
+            self._sel = selectors.DefaultSelector()
+            self._sel.register(srv, selectors.EVENT_READ, "accept")
+            self._sel.register(self._wake_r, selectors.EVENT_READ, "wake")
+        except Exception:
+            # close-on-error-path: a failed bind/register must not leak
+            # the listener, the wakeup pair or the selector — the loop's
+            # finally never runs because the loop never starts
+            self._close_io()
+            raise
         self._thread = threading.Thread(
             target=self._loop, name="lgbt-fleet-gateway", daemon=True)
         self._thread.start()
@@ -194,6 +201,25 @@ class FleetServer:
                 daemon=True)
             self._stats_thread.start()
         return self
+
+    def _close_io(self) -> None:
+        """Best-effort close of the loop-owned io objects — the error
+        path of ``start()`` (the loop's ``finally`` owns the happy
+        path)."""
+        if self._sel is not None:
+            try:
+                self._sel.close()
+            except OSError:
+                pass
+            self._sel = None
+        for attr in ("_srv", "_wake_r", "_wake_w"):
+            s = getattr(self, attr)
+            if s is not None:
+                try:
+                    s.close()
+                except OSError:
+                    pass
+                setattr(self, attr, None)
 
     def stop(self) -> None:
         if self._stop.is_set():
@@ -206,6 +232,10 @@ class FleetServer:
         self._wake()
         if self._thread is not None:
             self._thread.join(timeout=5.0)
+        if self._stats_thread is not None:
+            # the snapshot loop wakes on the same stop event; joining it
+            # here means no snapshot write can race the final one below
+            self._stats_thread.join(timeout=5.0)
         self.replicas.stop()
         if self.telemetry_out:
             from ...observability import write_report

@@ -152,10 +152,16 @@ class PredictionServer:
 
     def start(self) -> "PredictionServer":
         srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        srv.bind((self.host, self.port))
-        srv.listen(16)
-        srv.settimeout(0.25)          # poll the stop flag
+        try:
+            srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            srv.bind((self.host, self.port))
+            srv.listen(16)
+            srv.settimeout(0.25)          # poll the stop flag
+        except OSError:
+            # close-on-error-path: a failed bind (port in use) must not
+            # leak the listener fd
+            srv.close()
+            raise
         self.port = srv.getsockname()[1]
         self._srv = srv
         self._accept_thread = threading.Thread(
@@ -180,6 +186,13 @@ class PredictionServer:
             batchers = list(self._batchers.values())
         for b in batchers:
             b.stop()
+        # join-on-stop: the accept loop exits on the closed listener and
+        # the stats loop wakes on the stop event — wait for both so no
+        # daemon thread outlives stop() and races the final snapshot
+        if self._accept_thread is not None:
+            self._accept_thread.join(timeout=5.0)
+        if self._stats_thread is not None:
+            self._stats_thread.join(timeout=5.0)
         if self.telemetry_out:
             from ..observability import write_report
             write_report(self.report(), self.telemetry_out)
@@ -477,6 +490,7 @@ class ServingClient:
         backoff = self._backoff_s
         last: Optional[BaseException] = None
         for attempt in range(self._retries + 1):
+            s: Optional[socket.socket] = None
             try:
                 s = socket.create_connection((self._host, self._port),
                                              timeout=self._timeout)
@@ -493,6 +507,7 @@ class ServingClient:
                             s.close()
                         except OSError:
                             pass
+                        s = None
                         s = socket.create_connection(
                             (self._host, self._port),
                             timeout=self._timeout)
@@ -501,9 +516,23 @@ class ServingClient:
                 return
             except ServerUnavailable:
                 # pinned protocol="binary" against a non-binary server:
-                # a definitive answer, not a transient to retry
+                # a definitive answer, not a transient to retry — but
+                # the probe socket must still close on the way out
+                if s is not None:
+                    try:
+                        s.close()
+                    except OSError:
+                        pass
                 raise
             except OSError as e:
+                # close-on-error-path: a socket that connected but then
+                # failed (probe timeout, reset mid-negotiation) would
+                # otherwise leak an fd per retry
+                if s is not None:
+                    try:
+                        s.close()
+                    except OSError:
+                        pass
                 last = e
                 rel_inc("serve.client_connect_retries")
                 if attempt >= self._retries:

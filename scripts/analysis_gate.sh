@@ -1,14 +1,24 @@
 #!/usr/bin/env bash
-# The analysis gate as one command outside pytest: run all six passes,
-# write the schema-validated JSON report next to the observability
-# artifacts, and exit non-zero on any unsuppressed finding.
+# The analysis gate as one command outside pytest: run all eight passes
+# (plus the always-on allowlist-staleness check), write the
+# schema-validated JSON report next to the observability artifacts, and
+# exit non-zero on any unsuppressed finding.  Per-pass wall time is
+# printed as each pass completes and summarized at the end (and lands in
+# the report's "seconds" fields).
 #
-#   scripts/analysis_gate.sh                      # full gate
+#   scripts/analysis_gate.sh                      # full gate (~90s budget)
 #   scripts/analysis_gate.sh --programs 'wave*'   # scoped traced set
+#   scripts/analysis_gate.sh --changed-only origin/main
+#       # pre-push loop: AST file sets AND the traced-program set narrow
+#       # to `git diff --name-only origin/main` (+ untracked); recompile
+#       # and the allowlist check still run in full, and any change under
+#       # lightgbm_tpu/analysis/ falls back to the full gate
 #   ANALYSIS_REPORT=out.json scripts/analysis_gate.sh
 #
 # Extra arguments pass through to `python -m lightgbm_tpu.analysis`
-# (e.g. --passes lint,spmd,donation for a no-trace quick check).
+# (e.g. --passes lint,spmd,donation for a no-trace quick check, or
+# --dump-costs / --dump-budgets / --dump-sequences to re-pin artifacts
+# after a reviewed change).
 set -euo pipefail
 
 REPO_ROOT="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"

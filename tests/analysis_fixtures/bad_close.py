@@ -1,0 +1,36 @@
+"""Intentionally-leaked fds: every shape here must trip
+LGB012-close-on-all-paths.  Parsed by the analyzer in tests, never
+imported."""
+
+import selectors
+import socket
+
+
+def local_socket_leaked(host, port):
+    # LGB012: created, used, never closed and never handed off
+    s = socket.create_connection((host, port), timeout=1.0)
+    s.sendall(b"hello")
+
+
+class AttrSocketNeverClosed:
+    # LGB012: stored on self but no method of the class closes it
+    def __init__(self, host, port):
+        self._sock = socket.create_connection((host, port), timeout=1.0)
+
+    def send(self, data):
+        self._sock.sendall(data)
+
+
+class SelectorNeverClosed:
+    # LGB012: selector stored on self, never closed
+    def __init__(self):
+        self._sel = selectors.DefaultSelector()
+
+    def poll(self):
+        return self._sel.select(timeout=0.1)
+
+
+def open_without_close(path):
+    # LGB012: non-with open result never closed
+    fh = open(path)
+    return fh.read(10)

@@ -1,0 +1,31 @@
+"""Intentionally-unreaped children: every shape here must trip
+LGB013-subprocess-reap.  Parsed by the analyzer in tests, never
+imported."""
+
+import subprocess
+import sys
+
+
+def popen_discarded():
+    # LGB013: the handle is dropped — the child becomes a zombie
+    subprocess.Popen([sys.executable, "-c", "pass"])
+
+
+def popen_never_reaped():
+    # LGB013: local Popen with no wait/communicate/terminate/kill path
+    proc = subprocess.Popen([sys.executable, "-c", "pass"])
+    return proc.pid
+
+
+class AttrPopenNeverReaped:
+    # LGB013: stored on self but no method of the class reaps it
+    def __init__(self):
+        self._proc = subprocess.Popen([sys.executable, "-c", "pass"])
+
+    def pid(self):
+        return self._proc.pid
+
+
+def run_without_timeout():
+    # LGB013: a wedged child blocks this call forever
+    subprocess.run([sys.executable, "-c", "pass"], check=True)

@@ -1,0 +1,46 @@
+"""Intentionally-bad thread lifecycles: every shape here must trip
+LGB011-thread-lifecycle.  Parsed by the analyzer in tests, never
+imported."""
+
+import threading
+
+
+class FlagOnlyStop:
+    # LGB011: stop() sets the event but never joins — signalling is not
+    # quiescence; the daemon keeps running through the "stopped" state
+    def __init__(self):
+        self._stop = threading.Event()
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while not self._stop.wait(0.1):
+            pass
+
+    def stop(self):
+        self._stop.set()
+
+
+class NonDaemonNeverJoined:
+    # LGB011: non-daemon attr thread with no join anywhere in the class
+    def __init__(self):
+        self._worker = threading.Thread(target=self._run)
+        self._worker.start()
+
+    def _run(self):
+        pass
+
+
+def fire_and_forget_non_daemon(fn):
+    # LGB011: anonymous non-daemon thread can never be joined
+    threading.Thread(target=fn).start()
+
+
+def local_thread_never_joined(fn):
+    # LGB011: local non-daemon thread, no join in this function
+    t = threading.Thread(target=fn)
+    t.start()
+    return None

@@ -1,0 +1,145 @@
+"""Sanctioned resource-lifecycle shapes: everything here must pass
+LGB011/LGB012/LGB013 clean — each mirrors a real pattern the package
+uses.  Parsed by the analyzer in tests, never imported."""
+
+import selectors
+import socket
+import subprocess
+import sys
+import threading
+
+
+class JoinOnStop:
+    # the serving/batcher shape: attr thread joined by the teardown
+    def __init__(self):
+        self._stop = threading.Event()
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while not self._stop.wait(0.1):
+            pass
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+
+
+class AliasJoin:
+    # the lifecycle/autopilot shape: join through a local alias
+    def __init__(self):
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        pass
+
+    def stop(self):
+        t = self._thread
+        if t is not None:
+            t.join(timeout=5.0)
+
+
+class StopEventDaemon:
+    # the RollbackWatchdog shape: daemon + stop event, NO teardown-named
+    # method — callers wait on a done event instead of joining
+    def __init__(self):
+        self._stop = threading.Event()
+        self._done = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        self._done.set()
+
+    def cancel(self):
+        self._stop.set()
+
+    def wait(self, timeout=None):
+        return self._done.wait(timeout)
+
+
+def fire_and_forget_daemon(fn):
+    # the gateway side-thread shape: daemon fire-and-forget is sanctioned
+    threading.Thread(target=fn, daemon=True).start()
+
+
+def scatter_join(fns):
+    # the io/distributed shape: local worker list joined in-function
+    threads = [threading.Thread(target=fn) for fn in fns]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+
+class ForTupleClose:
+    # the gateway loop shape: several fds closed through one tuple walk
+    def __init__(self):
+        self._sel = selectors.DefaultSelector()
+        self._wake_r, self._wake_w = socket.socketpair()
+
+    def close(self):
+        for s in (self._wake_r, self._wake_w):
+            try:
+                s.close()
+            except OSError:
+                pass
+        self._sel.close()
+
+
+class GetattrClose:
+    # the io/net shape: teardown reaches the fd through getattr
+    def __init__(self, host, port):
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.settimeout(1.0)
+        srv.bind((host, port))
+        self._srv = srv
+
+    def close(self):
+        srv = getattr(self, "_srv", None)
+        if srv is not None:
+            srv.close()
+
+
+def with_open(path):
+    # context managers are the preferred close-on-all-paths form
+    with open(path) as fh:
+        return fh.read(10)
+
+
+def close_on_error_path(host, port):
+    # the ServingClient shape: close in the handler before re-raising
+    s = None
+    try:
+        s = socket.create_connection((host, port), timeout=1.0)
+        s.sendall(b"ping")
+        return s
+    except OSError:
+        if s is not None:
+            s.close()
+        raise
+
+
+def popen_reaped(log_path):
+    # the elastic/controller shape: explicit wait + kill-and-reap arm
+    with open(log_path, "w") as log:
+        proc = subprocess.Popen([sys.executable, "-c", "pass"],
+                                stdout=log, stderr=subprocess.STDOUT)
+        try:
+            rc = proc.wait(timeout=5.0)
+        except subprocess.TimeoutExpired:
+            rc = None
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
+    return rc
+
+
+def run_with_timeout():
+    # bounded run() is fine: the timeout arm kills and reaps internally
+    return subprocess.run([sys.executable, "-c", "pass"],
+                          timeout=5.0).returncode

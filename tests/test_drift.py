@@ -144,7 +144,7 @@ def validate_drift_section(sec):
     from lightgbm_tpu.observability.telemetry import SCHEMA_VERSION
     from lightgbm_tpu.serving.batcher import ServingStats
     rep = ServingStats().report()
-    assert rep["schema_version"] == SCHEMA_VERSION == 10
+    assert rep["schema_version"] == SCHEMA_VERSION == 11
     rep["drift"] = sec
     errs = validate_report(rep)
     assert errs == [], errs

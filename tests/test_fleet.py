@@ -560,7 +560,7 @@ def test_fleet_tenants_drift_and_http_scrape(rng, bst_a, bst_b):
             text_op = cb.metrics()
 
             assert validate_report(rep) == [], validate_report(rep)
-            assert rep["schema_version"] == 10
+            assert rep["schema_version"] == 11
             tenants = {t["model"]: t for t in rep["serving"]["tenants"]}
             assert set(tenants) == {"default", "alt"}
             for t in tenants.values():

@@ -371,7 +371,7 @@ def test_elastic_shrink_survives_rank_death(tmp_path):
         assert sec["recovery_wall_s"] > 0.0
         with open(specs[rank]["telemetry_out"]) as fh:
             rep = json.load(fh)
-        assert rep["schema_version"] == 10
+        assert rep["schema_version"] == 11
         assert validate_report(rep, load_schema()) == []
         assert rep["elastic"]["recoveries"] == 1
         # controller trace: epoch spans + the recovery span
@@ -515,7 +515,7 @@ def test_telemetry_elastic_section_schema():
 
     tel = Telemetry(True)
     rep = tel.report()
-    assert rep["schema_version"] == 10
+    assert rep["schema_version"] == 11
     assert "elastic" not in rep            # strictly opt-in
     tel.set_elastic(epoch=1, members=2, recoveries=1, ranks_lost=1)
     rep = tel.report()

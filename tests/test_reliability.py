@@ -421,6 +421,6 @@ def test_reliability_section_in_training_report(rng):
     bst = lgb.train(dict(p), lgb.Dataset(X, label=y, params=dict(p)), 3,
                     verbose_eval=False)
     rep = bst.get_telemetry()
-    assert rep["schema_version"] == 10  # v10: optional autopilot section
+    assert rep["schema_version"] == 11  # v11: provenance cost-ledger sha
     assert "counters" in rep["reliability"]
     assert validate_report(rep) == []

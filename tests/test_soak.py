@@ -219,7 +219,7 @@ def _assert_contract(drill, *, budget_max):
     assert min(drill.counts) > 0, drill.counts
 
     rep = drill.server.report()
-    assert rep["schema_version"] == SCHEMA_VERSION == 10
+    assert rep["schema_version"] == SCHEMA_VERSION == 11
     assert validate_report(rep) == []
 
     sec = rep["autopilot"]

@@ -315,6 +315,13 @@ def test_provenance_block(rng):
     assert prov["emulated"] == (jax.devices()[0].platform != "tpu")
     assert prov["tree_learner"] == "serial"
     assert prov["learner"] == type(bst.gbdt.learner).__name__
+    # schema v11: the provenance block pins the exact static cost ledger
+    # (analysis/costs.json) the run was gated against
+    import hashlib
+    from lightgbm_tpu.analysis.common import COSTS_PATH
+    with open(COSTS_PATH, "rb") as fh:
+        want = hashlib.sha256(fh.read()).hexdigest()
+    assert prov["cost_ledger_sha256"] == want
     # the disabled report has one too (schema: required section)
     ds2 = lgb.Dataset(X, label=y, params=dict(_BASE))
     bst2 = lgb.Booster(dict(_BASE), ds2)

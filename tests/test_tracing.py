@@ -431,6 +431,10 @@ def test_bench_serving_smoke(tmp_path):
     assert "closed_p99_ms" in line and "open_qps" in line
     report = json.loads(out.read_text())
     assert validate_report(report, BENCH_SERVING_SCHEMA) == []
+    # schema v2: provenance pins the cost ledger the run was gated under
+    assert report["schema_version"] == 2
+    sha = report["provenance"]["cost_ledger_sha256"]
+    assert isinstance(sha, str) and len(sha) == 64
     assert report["closed_loop"]["ok"] > 0
     assert report["open_loop"]["requests"] >= 30 * 1
     assert report["server"]["batches"] > 0
